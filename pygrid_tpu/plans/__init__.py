@@ -1,0 +1,10 @@
+from pygrid_tpu.plans.placeholder import PlaceHolder  # noqa: F401
+from pygrid_tpu.plans.state import State  # noqa: F401
+from pygrid_tpu.plans.plan import Plan, func2plan  # noqa: F401
+from pygrid_tpu.plans.translators import (  # noqa: F401
+    PLAN_VARIANTS,
+    PlanTranslatorDefault,
+    PlanTranslatorPortable,
+    PlanTranslatorXla,
+    translate_plan,
+)

@@ -1,0 +1,59 @@
+"""State — the model-parameter container that crosses the wire.
+
+Parity surface: syft ``State`` as consumed by the reference's ModelManager
+(``models/model_manager.py:80-103``): ``serialize_model_params`` wraps a list
+of tensors in placeholders and protobuf-serializes; ``unserialize_model_params``
+returns ``state.tensors()``. Here a State is an ordered list of
+:class:`PlaceHolder` — i.e. a flattened pytree leaf list with stable ids — and
+serde rides :mod:`pygrid_tpu.serde`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from pygrid_tpu.plans.placeholder import PlaceHolder
+from pygrid_tpu.serde import deserialize, register_serde, serialize
+
+
+@register_serde(name="pygrid.State")
+class State:
+    __slots__ = ("state_placeholders",)
+
+    def __init__(self, state_placeholders: Iterable[PlaceHolder] = ()) -> None:
+        self.state_placeholders = list(state_placeholders)
+
+    @classmethod
+    def from_tensors(cls, tensors: Sequence[Any]) -> "State":
+        return cls([PlaceHolder().instantiate(t) for t in tensors])
+
+    def tensors(self) -> list[Any]:
+        return [ph.tensor for ph in self.state_placeholders]
+
+    def _bufferize(self) -> dict:
+        return {"placeholders": self.state_placeholders}
+
+    @classmethod
+    def _unbufferize(cls, data: dict) -> "State":
+        return cls(data["placeholders"])
+
+    def __len__(self) -> int:
+        return len(self.state_placeholders)
+
+    def __repr__(self) -> str:
+        return f"State({self.state_placeholders!r})"
+
+
+def serialize_model_params(params: Sequence[Any]) -> bytes:
+    """list-of-arrays -> wire bytes (reference model_manager.py:80-92)."""
+    return serialize(State.from_tensors([np.asarray(p) for p in params]))
+
+
+def unserialize_model_params(blob: bytes) -> list[np.ndarray]:
+    """wire bytes -> list-of-arrays (reference model_manager.py:95-103)."""
+    state = deserialize(blob)
+    if not isinstance(state, State):
+        raise TypeError("blob does not contain a State")
+    return state.tensors()

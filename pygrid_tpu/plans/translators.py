@@ -1,0 +1,341 @@
+"""Plan translation — the portable op-list dialect and variant registry.
+
+Parity surface: reference ``syft_assets/plan_manager.py:119-149`` trims each
+hosted plan into three stored variants (torch-op "list", TorchScript, tfjs)
+via ``PlanTranslator{Default,Torchscript,Tfjs}``. Here the variants are:
+
+- ``PlanTranslatorDefault``  -> ``"list"``: a JSON-able walk of the jaxpr —
+  every equation as ``{"op", "in", "out", "params"}`` with integer SSA ids.
+  Foreign clients (e.g. a JS worker) can interpret this dialect; we also ship
+  a reference interpreter (:func:`run_oplist`) used by tests to prove the
+  dialect is executable.
+- ``PlanTranslatorXla``      -> ``"xla"``: serialized ``jax.export`` artifact
+  (multi-platform StableHLO). What nodes/TPUs execute. TorchScript analog.
+- ``PlanTranslatorPortable`` -> ``"code"``: human-readable jaxpr text.
+  tfjs-slot analog (a display/debug portable form).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.extend.core
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pygrid_tpu.utils.exceptions import PlanTranslationError
+
+# --- jaxpr -> oplist --------------------------------------------------------
+
+
+def _sanitize_param(value: Any) -> Any:
+    """Convert one eqn param into a wire-safe structure."""
+    if isinstance(value, (bool, int, float, str, type(None))):
+        return value
+    if isinstance(value, (np.dtype,)) or (
+        isinstance(value, type) and issubclass(value, np.generic)
+    ):
+        return {"__dtype__": np.dtype(value).name}
+    if hasattr(value, "dtype") and hasattr(value, "shape") and not callable(value):
+        return np.asarray(value)
+    if isinstance(value, (tuple, list)):
+        return [_sanitize_param(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _sanitize_param(v) for k, v in value.items()}
+    if isinstance(value, jax.extend.core.ClosedJaxpr) or type(value).__name__ in (
+        "ClosedJaxpr",
+        "Jaxpr",
+    ):
+        closed = value
+        if type(value).__name__ == "Jaxpr":  # wrap open jaxpr
+            closed = jax.extend.core.ClosedJaxpr(value, ())
+        return {"__jaxpr__": jaxpr_to_oplist(closed)}
+    if callable(value):
+        return {"__callable__": getattr(value, "__name__", repr(value))}
+    return {"__repr__": repr(value)}
+
+
+def jaxpr_to_oplist(closed_jaxpr) -> dict:
+    """Walk a ClosedJaxpr into the portable op-list dialect."""
+    jaxpr = closed_jaxpr.jaxpr
+    var_ids: dict[Any, int] = {}
+
+    def vid(var) -> int:
+        if var not in var_ids:
+            var_ids[var] = len(var_ids)
+        return var_ids[var]
+
+    def ref(atom) -> Any:
+        # Literal values are embedded; variables become integer SSA ids.
+        if hasattr(atom, "val"):
+            val = atom.val
+            if isinstance(val, (bool, int, float)):
+                return {"lit": val}
+            return {"lit_arr": np.asarray(val)}
+        return {"var": vid(atom)}
+
+    constvars = [vid(v) for v in jaxpr.constvars]
+    invars = [vid(v) for v in jaxpr.invars]
+    eqns = []
+    for eqn in jaxpr.eqns:
+        eqns.append(
+            {
+                "op": eqn.primitive.name,
+                "in": [ref(a) for a in eqn.invars],
+                "out": [vid(v) for v in eqn.outvars],
+                "params": {k: _sanitize_param(v) for k, v in eqn.params.items()},
+            }
+        )
+    outvars = [ref(a) for a in jaxpr.outvars]
+    return {
+        "constvars": constvars,
+        "consts": [np.asarray(c) for c in closed_jaxpr.consts],
+        "invars": invars,
+        "eqns": eqns,
+        "outvars": outvars,
+    }
+
+
+# --- oplist interpreter -----------------------------------------------------
+#
+# A reference interpreter for the "list" dialect, covering the op vocabulary
+# of MLP/CNN forward+grad training plans. Exec on nodes uses the "xla"
+# variant; this exists so the portable dialect is demonstrably executable
+# (tests/unit/test_plans.py round-trips training plans through it).
+
+
+def _dt(p):
+    return np.dtype(p["__dtype__"]) if isinstance(p, dict) else np.dtype(p)
+
+
+def _dims(x) -> tuple[int, ...]:
+    """Coerce a sanitized dims param (list of ints / 0-d arrays) to ints."""
+    if x is None:
+        return ()
+    return tuple(int(np.asarray(v)) for v in x)
+
+
+def _tt(x):  # tuple-of-tuples from sanitized lists
+    return tuple(tuple(v) if isinstance(v, list) else v for v in x)
+
+
+def _dot_general(a, b, params):
+    dnums = _tt(params["dimension_numbers"])
+    contracting = tuple(tuple(d) for d in dnums[0])
+    batch = tuple(tuple(d) for d in dnums[1])
+    return lax.dot_general(a, b, dimension_numbers=(contracting, batch))
+
+
+def _conv(a, b, params):
+    return lax.conv_general_dilated(
+        a,
+        b,
+        window_strides=_dims(params["window_strides"]),
+        padding=[_dims(p) for p in params["padding"]],
+        lhs_dilation=_dims(params["lhs_dilation"]),
+        rhs_dilation=_dims(params["rhs_dilation"]),
+        dimension_numbers=lax.ConvDimensionNumbers(
+            *[tuple(d) for d in params["dimension_numbers"]]
+        ),
+        feature_group_count=params["feature_group_count"],
+        batch_group_count=params["batch_group_count"],
+    )
+
+
+def _reduce(fn):
+    def run(x, params):
+        return fn(x, axis=_dims(params["axes"]))
+
+    return run
+
+
+_INTERP_TABLE: dict[str, Any] = {
+    "add": lambda a, b, p: jnp.add(a, b),
+    "add_any": lambda a, b, p: jnp.add(a, b),  # autodiff accumulation
+    "rem": lambda a, b, p: lax.rem(a, b),
+    "atan2": lambda a, b, p: lax.atan2(a, b),
+    "nextafter": lambda a, b, p: jnp.nextafter(a, b),
+    "clamp": lambda lo, x, hi, p: lax.clamp(lo, x, hi),
+    "cumsum": lambda a, p: lax.cumsum(
+        a, axis=int(np.asarray(p["axis"])), reverse=bool(p.get("reverse", False))
+    ),
+    "sub": lambda a, b, p: jnp.subtract(a, b),
+    "mul": lambda a, b, p: jnp.multiply(a, b),
+    "div": lambda a, b, p: jnp.divide(a, b),
+    "pow": lambda a, b, p: jnp.power(a, b),
+    "max": lambda a, b, p: jnp.maximum(a, b),
+    "min": lambda a, b, p: jnp.minimum(a, b),
+    "and": lambda a, b, p: jnp.logical_and(a, b),
+    "or": lambda a, b, p: jnp.logical_or(a, b),
+    "xor": lambda a, b, p: jnp.logical_xor(a, b),
+    "gt": lambda a, b, p: jnp.greater(a, b),
+    "lt": lambda a, b, p: jnp.less(a, b),
+    "ge": lambda a, b, p: jnp.greater_equal(a, b),
+    "le": lambda a, b, p: jnp.less_equal(a, b),
+    "eq": lambda a, b, p: jnp.equal(a, b),
+    "ne": lambda a, b, p: jnp.not_equal(a, b),
+    "neg": lambda a, p: jnp.negative(a),
+    "sign": lambda a, p: jnp.sign(a),
+    "abs": lambda a, p: jnp.abs(a),
+    "exp": lambda a, p: jnp.exp(a),
+    "log": lambda a, p: jnp.log(a),
+    "tanh": lambda a, p: jnp.tanh(a),
+    "sqrt": lambda a, p: jnp.sqrt(a),
+    "rsqrt": lambda a, p: lax.rsqrt(a),
+    "logistic": lambda a, p: jax.nn.sigmoid(a),
+    "floor": lambda a, p: jnp.floor(a),
+    "ceil": lambda a, p: jnp.ceil(a),
+    "round": lambda a, p: jnp.round(a),
+    "is_finite": lambda a, p: jnp.isfinite(a),
+    "stop_gradient": lambda a, p: a,
+    "copy": lambda a, p: a,
+    "integer_pow": lambda a, p: lax.integer_pow(a, int(p["y"])),
+    "exp2": lambda a, p: jnp.exp2(a),
+    "square": lambda a, p: jnp.square(a),
+    "convert_element_type": lambda a, p: lax.convert_element_type(
+        a, _dt(p["new_dtype"])
+    ),
+    "reshape": lambda a, p: lax.reshape(a, _dims(p["new_sizes"])),
+    "squeeze": lambda a, p: lax.squeeze(a, _dims(p["dimensions"])),
+    "expand_dims": lambda a, p: lax.expand_dims(a, _dims(p["dimensions"])),
+    "transpose": lambda a, p: lax.transpose(a, _dims(p["permutation"])),
+    "broadcast_in_dim": lambda a, p: lax.broadcast_in_dim(
+        a, _dims(p["shape"]), _dims(p["broadcast_dimensions"])
+    ),
+    "slice": lambda a, p: lax.slice(
+        a,
+        _dims(p["start_indices"]),
+        _dims(p["limit_indices"]),
+        _dims(p["strides"]) if p.get("strides") else None,
+    ),
+    "rev": lambda a, p: lax.rev(a, _dims(p["dimensions"])),
+    "reduce_sum": _reduce(jnp.sum),
+    "reduce_max": _reduce(jnp.max),
+    "reduce_min": _reduce(jnp.min),
+    "reduce_prod": _reduce(jnp.prod),
+    "reduce_and": _reduce(jnp.all),
+    "reduce_or": _reduce(jnp.any),
+    "argmax": lambda a, p: jnp.argmax(a, axis=_dims(p["axes"])[0]).astype(
+        _dt(p["index_dtype"])
+    ),
+    "argmin": lambda a, p: jnp.argmin(a, axis=_dims(p["axes"])[0]).astype(
+        _dt(p["index_dtype"])
+    ),
+    "select_n": lambda *args: jnp.select(
+        [args[0] == i for i in range(len(args[1:-1]))], list(args[1:-1])
+    )
+    if len(args) > 4
+    else jnp.where(args[0], args[2], args[1]),
+    "dot_general": _dot_general,
+    "conv_general_dilated": _conv,
+    "concatenate": lambda *args: lax.concatenate(
+        list(args[:-1]), int(args[-1]["dimension"])
+    ),
+    "iota": lambda p: lax.broadcasted_iota(
+        _dt(p["dtype"]), _dims(p["shape"]), int(p["dimension"])
+    ),
+    "dynamic_slice": lambda *args: lax.dynamic_slice(
+        args[0], args[1:-1], _dims(args[-1]["slice_sizes"])
+    ),
+    "dynamic_update_slice": lambda a, u, *rest: lax.dynamic_update_slice(
+        a, u, rest[:-1]
+    ),
+}
+
+
+def run_oplist(oplist: dict, *args: Any) -> Any:
+    """Interpret the portable op-list dialect. Returns the plan outputs."""
+    env: dict[int, Any] = {}
+    for cid, cval in zip(oplist["constvars"], oplist["consts"]):
+        env[cid] = jnp.asarray(cval)
+    if len(args) != len(oplist["invars"]):
+        raise PlanTranslationError(
+            f"oplist expects {len(oplist['invars'])} args, got {len(args)}"
+        )
+    for iid, a in zip(oplist["invars"], args):
+        env[iid] = jnp.asarray(a)
+
+    def read(r):
+        if "var" in r:
+            return env[r["var"]]
+        if "lit" in r:
+            return r["lit"]
+        return jnp.asarray(r["lit_arr"])
+
+    for eqn in oplist["eqns"]:
+        op, params = eqn["op"], eqn["params"]
+        invals = [read(r) for r in eqn["in"]]
+        if op in ("jit", "pjit", "closed_call", "custom_jvp_call",
+                  "custom_vjp_call", "custom_jvp_call_jaxpr", "remat",
+                  "checkpoint", "custom_transpose_call"):
+            inner = None
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                cand = params.get(key)
+                if isinstance(cand, dict) and "__jaxpr__" in cand:
+                    inner = cand["__jaxpr__"]
+                    break
+            if inner is None:
+                raise PlanTranslationError(f"no inner jaxpr for {op}")
+            outs = run_oplist(inner, *invals)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        else:
+            fn = _INTERP_TABLE.get(op)
+            if fn is None:
+                raise PlanTranslationError(f"op {op!r} not in portable dialect")
+            outs = [fn(params)] if op == "iota" else [fn(*invals, params)]
+        for oid, oval in zip(eqn["out"], outs):
+            env[oid] = oval
+
+    results = [read(r) for r in oplist["outvars"]]
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+# --- variant registry -------------------------------------------------------
+
+
+class PlanTranslatorDefault:
+    variant = "list"
+
+    @staticmethod
+    def translate(plan) -> Any:
+        if plan.oplist is None:
+            raise PlanTranslationError("plan has no oplist (not built?)")
+        return plan.oplist
+
+
+class PlanTranslatorXla:
+    variant = "xla"
+
+    @staticmethod
+    def translate(plan) -> Any:
+        if plan.exported_blob is None:
+            raise PlanTranslationError("plan has no exported XLA artifact")
+        return plan.exported_blob
+
+
+class PlanTranslatorPortable:
+    variant = "code"
+
+    @staticmethod
+    def translate(plan) -> Any:
+        return plan.code
+
+
+PLAN_VARIANTS = {
+    t.variant: t
+    for t in (PlanTranslatorDefault, PlanTranslatorXla, PlanTranslatorPortable)
+}
+# wire-compat aliases for syft.js-era clients (reference routes accept
+# receive_operations_as ∈ {list, torchscript, tfjs} — routes.py:228-233)
+PLAN_VARIANT_ALIASES = {"torchscript": "xla", "tfjs": "code", "list": "list"}
+
+
+def translate_plan(plan, variant: str) -> Any:
+    variant = PLAN_VARIANT_ALIASES.get(variant, variant)
+    translator = PLAN_VARIANTS.get(variant)
+    if translator is None:
+        raise PlanTranslationError(f"unknown plan variant {variant!r}")
+    return translator.translate(plan)

@@ -1,0 +1,7 @@
+from pygrid_tpu.serde.wire import (  # noqa: F401
+    deserialize,
+    from_hex,
+    register_serde,
+    serialize,
+    to_hex,
+)

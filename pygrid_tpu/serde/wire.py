@@ -1,0 +1,174 @@
+"""Wire serialization for tensors, States, Plans and messages.
+
+The reference delegates this to syft-0.2.9 serde + syft-proto protobufs
+(consumed at reference ``models/model_manager.py:88-101`` and
+``syft_assets/plan_manager.py:104-117``). Here the wire format is msgpack with
+two extension codes:
+
+- ``EXT_NDARRAY`` (0x01): ``[dtype_str, shape, raw_bytes]`` — zero-copy-able
+  row-major buffer. JAX arrays are materialized to host numpy on serialize;
+  deserialize returns numpy (device placement is the caller's decision, so
+  host↔HBM transfers stay explicit).
+- ``EXT_OBJECT`` (0x02): ``[type_name, payload]`` for any class registered via
+  :func:`register_serde` — the class provides ``_bufferize``/``_unbufferize``
+  (names kept from the syft serde surface the reference consumes).
+
+The format is self-contained and versioned by ``WIRE_VERSION`` so node and
+client builds can interoperate across releases.
+"""
+
+from __future__ import annotations
+
+import binascii
+from typing import Any, Callable
+
+import msgpack
+import numpy as np
+
+WIRE_VERSION = 1
+
+EXT_NDARRAY = 0x01
+EXT_OBJECT = 0x02
+
+# type name -> (cls, bufferize, unbufferize)
+_REGISTRY: dict[str, tuple[type, Callable, Callable]] = {}
+# cls -> type name
+_CLS_NAMES: dict[type, str] = {}
+
+
+def register_serde(cls: type | None = None, *, name: str | None = None):
+    """Class decorator registering ``cls`` for wire serde.
+
+    ``cls`` must define ``_bufferize(self) -> Any`` returning a
+    msgpack-serializable structure (which may itself contain ndarrays or other
+    registered objects) and a classmethod ``_unbufferize(cls, data) -> cls``.
+    """
+
+    def _register(c: type) -> type:
+        type_name = name or f"{c.__module__}.{c.__qualname__}"
+        if not hasattr(c, "_bufferize") or not hasattr(c, "_unbufferize"):
+            raise TypeError(f"{c} must define _bufferize/_unbufferize")
+        _REGISTRY[type_name] = (c, c._bufferize, c._unbufferize)
+        _CLS_NAMES[c] = type_name
+        return c
+
+    return _register(cls) if cls is not None else _register
+
+
+def _is_jax_array(obj: Any) -> bool:
+    # Avoid importing jax at module load for light-weight clients.
+    mod = type(obj).__module__ or ""
+    return mod.startswith("jaxlib") or mod.startswith("jax")
+
+
+def _pack_ndarray(arr: np.ndarray) -> msgpack.ExtType:
+    arr = np.asarray(arr)
+    shape = list(arr.shape)  # before ascontiguousarray: it promotes 0-d to (1,)
+    payload = msgpack.packb(
+        [arr.dtype.str, shape, np.ascontiguousarray(arr).tobytes()],
+        use_bin_type=True,
+    )
+    return msgpack.ExtType(EXT_NDARRAY, payload)
+
+
+def _unpack_ndarray(payload: bytes) -> np.ndarray:
+    dtype_str, shape, raw = msgpack.unpackb(payload, raw=False)
+    # bytearray copy => writable result (frombuffer over bytes is read-only,
+    # which breaks in-place param updates downstream).
+    return np.frombuffer(bytearray(raw), dtype=np.dtype(dtype_str)).reshape(shape)
+
+
+def _default(obj: Any):
+    if isinstance(obj, np.ndarray):
+        return _pack_ndarray(obj)
+    if isinstance(obj, (np.generic,)):
+        return _pack_ndarray(np.asarray(obj))
+    if _is_jax_array(obj) and hasattr(obj, "dtype") and hasattr(obj, "shape"):
+        return _pack_ndarray(np.asarray(obj))
+    cls = type(obj)
+    type_name = _CLS_NAMES.get(cls)
+    if type_name is None:
+        # walk the MRO so subclasses of registered classes serialize too
+        for base in cls.__mro__[1:]:
+            type_name = _CLS_NAMES.get(base)
+            if type_name is not None:
+                break
+    if type_name is not None:
+        _, bufferize, _ = _REGISTRY[type_name]
+        # Type name packed as its own leading msgpack object (not inside one
+        # array) so deserialization can read it without decoding the payload.
+        inner = msgpack.packb(type_name, use_bin_type=True) + msgpack.packb(
+            bufferize(obj), use_bin_type=True, default=_default
+        )
+        return msgpack.ExtType(EXT_OBJECT, inner)
+    if isinstance(obj, set):
+        return sorted(obj)
+    if isinstance(obj, tuple):
+        return list(obj)
+    raise TypeError(f"pygrid_tpu.serde: cannot serialize {cls!r}")
+
+
+def _ext_hook(code: int, payload: bytes):
+    if code == EXT_NDARRAY:
+        return _unpack_ndarray(payload)
+    if code == EXT_OBJECT:
+        unpacker = msgpack.Unpacker(
+            raw=False, ext_hook=_ext_hook, strict_map_key=False
+        )
+        unpacker.feed(payload)
+        # Read the leading type name alone, register its class (may import the
+        # defining module), then decode the payload exactly once.
+        type_name = unpacker.unpack()
+        _ensure_registered(type_name)
+        entry = _REGISTRY.get(type_name)
+        if entry is None:
+            raise TypeError(f"pygrid_tpu.serde: unknown wire type {type_name!r}")
+        data = unpacker.unpack()
+        _, _, unbufferize = entry
+        return unbufferize(data)
+    return msgpack.ExtType(code, payload)
+
+
+#: Modules that register wire types as an import side effect. Deserialization
+#: must work in processes that only imported ``pygrid_tpu.serde`` (e.g. a thin
+#: client), so unknown type names trigger a lazy import sweep of these.
+_LAZY_MODULES = (
+    "pygrid_tpu.plans",
+    "pygrid_tpu.smpc",
+    "pygrid_tpu.runtime",
+)
+
+
+def _ensure_registered(type_name: str) -> None:
+    if type_name in _REGISTRY:
+        return
+    import importlib
+
+    for mod in _LAZY_MODULES:
+        try:
+            importlib.import_module(mod)
+        except ImportError:
+            continue
+        if type_name in _REGISTRY:
+            return
+
+
+def serialize(obj: Any) -> bytes:
+    """Serialize ``obj`` (tensors, registered objects, plain structures)."""
+    return msgpack.packb(obj, use_bin_type=True, default=_default)
+
+
+def deserialize(blob: bytes | bytearray | memoryview) -> Any:
+    return msgpack.unpackb(
+        bytes(blob), raw=False, ext_hook=_ext_hook, strict_map_key=False
+    )
+
+
+def to_hex(obj: Any) -> str:
+    """Hex-string wrapper used by the host-training JSON payloads (parity with
+    reference fl_events.py:27-62 which unhexlifies model/plan fields)."""
+    return binascii.hexlify(serialize(obj)).decode()
+
+
+def from_hex(hexstr: str) -> Any:
+    return deserialize(binascii.unhexlify(hexstr))

@@ -1,0 +1,1 @@
+from pygrid_tpu.utils import codes, exceptions  # noqa: F401

@@ -1,0 +1,87 @@
+"""Wire serde round-trips (tensors, nested structures, registered types)."""
+
+import numpy as np
+import pytest
+
+from pygrid_tpu import serde
+from pygrid_tpu.plans import PlaceHolder, State
+from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
+
+
+def test_scalar_and_structure_roundtrip():
+    obj = {"a": 1, "b": [1.5, "x", None, True], "c": {"nested": [1, 2]}}
+    assert serde.deserialize(serde.serialize(obj)) == obj
+
+
+@pytest.mark.parametrize(
+    "dtype", [np.float32, np.int32, np.uint32, np.uint8, np.bool_, np.int8]
+)
+def test_ndarray_roundtrip(dtype):
+    arr = (np.arange(24).reshape(2, 3, 4) % 2).astype(dtype)
+    out = serde.deserialize(serde.serialize(arr))
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_zero_dim_array_roundtrip():
+    # regression: ascontiguousarray promotes 0-d to (1,); shape must survive
+    arr = np.asarray(np.float32(3.5))
+    out = serde.deserialize(serde.serialize(arr))
+    assert out.shape == () and out == np.float32(3.5)
+
+
+def test_jax_array_serializes_as_ndarray():
+    import jax.numpy as jnp
+
+    x = jnp.arange(6.0).reshape(2, 3)
+    out = serde.deserialize(serde.serialize(x))
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_allclose(out, np.asarray(x))
+
+
+def test_placeholder_and_state_roundtrip():
+    ph = PlaceHolder(np.ones((2, 2), np.float32), tags={"#x"}, description="d")
+    out = serde.deserialize(serde.serialize(ph))
+    assert out.id == ph.id and out.tags == {"#x"} and out.description == "d"
+    np.testing.assert_array_equal(out.tensor, ph.tensor)
+
+    state = State.from_tensors([np.ones(3), np.zeros((2, 2))])
+    out = serde.deserialize(serde.serialize(state))
+    assert isinstance(out, State) and len(out) == 2
+    ids = [p.id for p in state.state_placeholders]
+    assert [p.id for p in out.state_placeholders] == ids
+
+
+def test_model_params_serde():
+    params = [np.random.randn(4, 3).astype(np.float32), np.zeros(3, np.float32)]
+    blob = serialize_model_params(params)
+    out = unserialize_model_params(blob)
+    assert len(out) == 2
+    for a, b in zip(params, out):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_deserialized_arrays_are_writable():
+    out = serde.deserialize(serde.serialize(np.zeros((2, 2), np.float32)))
+    out[0, 0] = 5.0  # reference returns mutable tensors; so must we
+    assert out[0, 0] == 5.0
+
+
+def test_placeholder_ids_collision_safe():
+    ids = {PlaceHolder().id for _ in range(1000)}
+    assert len(ids) == 1000
+    assert all(i.bit_length() <= 63 for i in ids)
+
+
+def test_hex_wrappers():
+    obj = {"model": np.arange(4)}
+    out = serde.from_hex(serde.to_hex(obj))
+    np.testing.assert_array_equal(out["model"], np.arange(4))
+
+
+def test_unknown_type_raises():
+    class Foo:
+        pass
+
+    with pytest.raises(TypeError):
+        serde.serialize(Foo())
