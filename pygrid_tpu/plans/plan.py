@@ -42,14 +42,14 @@ def _export_platforms() -> tuple[str, ...]:
 
 
 def _export(fn: Callable, example_args: Sequence[Any]) -> jax_export.Exported:
+    import inspect
+
     jitted = jax.jit(fn)
-    try:
-        return jax_export.export(jitted, platforms=_export_platforms())(*example_args)
-    except TypeError:
-        # older spelling of the platforms kwarg
-        return jax_export.export(
-            jitted, lowering_platforms=_export_platforms()
-        )(*example_args)
+    # probe the signature for the kwarg name (renamed across jax versions);
+    # a try/except TypeError here would mask TypeErrors from tracing fn itself
+    params = inspect.signature(jax_export.export).parameters
+    kw = "platforms" if "platforms" in params else "lowering_platforms"
+    return jax_export.export(jitted, **{kw: _export_platforms()})(*example_args)
 
 
 @register_serde(name="pygrid.Plan")
@@ -71,6 +71,10 @@ class Plan:
         self.id = id or uuid.uuid4().hex
         self.fn = fn
         self.state = state if state is not None else State()
+        #: how many trailing plan inputs are fed from ``self.state`` (syft
+        #: parity: state tensors are implicit inputs appended at call time,
+        #: so updating plan.state between FL rounds changes execution)
+        self.n_state_inputs = 0
         self.input_specs = input_specs or []
         self.exported_blob = exported_blob
         self.oplist = oplist
@@ -84,11 +88,19 @@ class Plan:
     # --- build -------------------------------------------------------------
 
     def build(self, *example_args: Any) -> "Plan":
-        """Trace ``fn`` on example args, capture jaxpr + exported StableHLO."""
+        """Trace ``fn`` on example args, capture jaxpr + exported StableHLO.
+
+        If the plan carries a State, its tensors are appended as trailing
+        inputs — callers then invoke the plan with data args only and the
+        current ``self.state`` is injected at call time.
+        """
         if self.fn is None:
             raise PlanInvalidError("Plan has no function to build")
         from pygrid_tpu.plans.translators import jaxpr_to_oplist
 
+        state_tensors = [np.asarray(t) for t in self.state.tensors()]
+        self.n_state_inputs = len(state_tensors)
+        example_args = tuple(example_args) + tuple(state_tensors)
         closed = jax.make_jaxpr(self.fn)(*example_args)
         self.code = str(closed)
         self.oplist = jaxpr_to_oplist(closed)
@@ -116,15 +128,24 @@ class Plan:
         return self._exported.call
 
     def __call__(self, *args: Any):
+        if self.n_state_inputs:
+            args = tuple(args) + tuple(self.state.tensors())
         return self._callable()(*args)
+
 
     # --- serde -------------------------------------------------------------
 
     def _bufferize(self) -> dict:
+        # The full plan (all variants) crosses the wire only on host upload —
+        # the reference pays the same (server stores list/ts/tfjs variants,
+        # plan_manager.py:24-59). Worker downloads go through
+        # translate_plan(plan, variant) and carry exactly one variant
+        # (routes serve receive_operations_as — reference routes.py:228-233).
         return {
             "name": self.name,
             "id": self.id,
             "state": self.state,
+            "n_state_inputs": self.n_state_inputs,
             "input_specs": self.input_specs,
             "exported_blob": self.exported_blob,
             "oplist": self.oplist,
@@ -133,7 +154,7 @@ class Plan:
 
     @classmethod
     def _unbufferize(cls, data: dict) -> "Plan":
-        return cls(
+        plan = cls(
             name=data["name"],
             id=data["id"],
             state=data["state"],
@@ -142,6 +163,10 @@ class Plan:
             oplist=data["oplist"],
             code=data["code"],
         )
+        # .get: blobs from builds predating state injection never injected
+        # state, so 0 reproduces their behavior exactly
+        plan.n_state_inputs = data.get("n_state_inputs", 0)
+        return plan
 
     def __repr__(self) -> str:
         return (

@@ -17,7 +17,6 @@ via ``PlanTranslator{Default,Torchscript,Tfjs}``. Here the variants are:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax

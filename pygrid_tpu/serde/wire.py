@@ -86,13 +86,9 @@ def _default(obj: Any):
     if _is_jax_array(obj) and hasattr(obj, "dtype") and hasattr(obj, "shape"):
         return _pack_ndarray(np.asarray(obj))
     cls = type(obj)
+    # exact-class lookup only: silently serializing a subclass through its
+    # base would drop overridden fields and downcast on the far side
     type_name = _CLS_NAMES.get(cls)
-    if type_name is None:
-        # walk the MRO so subclasses of registered classes serialize too
-        for base in cls.__mro__[1:]:
-            type_name = _CLS_NAMES.get(base)
-            if type_name is not None:
-                break
     if type_name is not None:
         _, bufferize, _ = _REGISTRY[type_name]
         # Type name packed as its own leading msgpack object (not inside one

@@ -1,0 +1,21 @@
+from pygrid_tpu.smpc.ring import (  # noqa: F401
+    Ring64,
+    from_ring,
+    from_ring_signed,
+    ring_add,
+    ring_div_const,
+    ring_div_const_signed,
+    ring_matmul,
+    ring_mul,
+    ring_neg,
+    ring_random,
+    ring_sub,
+    to_ring,
+)
+from pygrid_tpu.smpc.fixed import FixedPointEncoder  # noqa: F401
+from pygrid_tpu.smpc.provider import CryptoProvider, CryptoStore  # noqa: F401
+from pygrid_tpu.smpc.additive import (  # noqa: F401
+    AdditiveSharingTensor,
+    FixedPrecisionTensor,
+    fix_prec,
+)

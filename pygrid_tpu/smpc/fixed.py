@@ -1,0 +1,58 @@
+"""Fixed-precision encoding into the 2^64 ring.
+
+Parity surface: syft's ``FixedPrecisionTensor`` (``.fix_prec()`` /
+``.float_prec()``) exercised by reference
+``tests/data_centric/test_basic_syft_operations.py:383-453`` — base-10
+encoding with ``precision_fractional=3`` by default, signed values living in
+two's complement mod 2^64.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pygrid_tpu.smpc.ring import (
+    Ring64,
+    from_ring_signed,
+    ring_div_const_signed,
+    to_ring,
+)
+
+DEFAULT_BASE = 10
+DEFAULT_PRECISION = 3
+
+
+class FixedPointEncoder:
+    def __init__(
+        self, base: int = DEFAULT_BASE, precision_fractional: int = DEFAULT_PRECISION
+    ) -> None:
+        if base ** precision_fractional >= (1 << 16):
+            raise ValueError(
+                "scale must stay < 2^16 so truncation's limb division is exact"
+            )
+        self.base = base
+        self.precision_fractional = precision_fractional
+        self.scale = base ** precision_fractional
+
+    def encode(self, x: np.ndarray) -> Ring64:
+        """float -> ring element round(x * scale) in two's complement."""
+        v = np.round(np.asarray(x, dtype=np.float64) * self.scale).astype(np.int64)
+        return to_ring(v.astype(np.uint64))
+
+    def decode(self, r: Ring64) -> np.ndarray:
+        """ring element -> float (host-side, exact int64 then divide)."""
+        return from_ring_signed(r).astype(np.float64) / self.scale
+
+    def truncate(self, r: Ring64) -> Ring64:
+        """Rescale after a fixed-point multiply: signed divide by scale.
+
+        On-device (jit-safe): used by the Beaver mul/matmul path where the
+        product carries scale^2.
+        """
+        return ring_div_const_signed(r, self.scale)
+
+    def __repr__(self) -> str:
+        return (
+            f"FixedPointEncoder(base={self.base}, "
+            f"precision_fractional={self.precision_fractional})"
+        )

@@ -1,0 +1,127 @@
+"""Crypto provider — Beaver triple generation and the primitive store.
+
+Parity surface: syft's ``crypto_provider`` worker and its crypto-store refill
+protocol (``EmptyCryptoPrimitiveStoreError`` caught and serialized back at
+reference ``events/data_centric/syft_events.py:34-38``; the provider is the
+jth worker in ``x.share(alice, bob, crypto_provider=james)`` —
+``test_basic_syft_operations.py:455-491``).
+
+TPU-native: triples are generated *on device* (ring ops are jitted XLA) and
+stored stacked over the party axis, so provisioning a batch of thousands of
+simulated parties is one program launch.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from pygrid_tpu.smpc import ring as R
+from pygrid_tpu.smpc.kernels import reconstruct_kernel, share_kernel
+from pygrid_tpu.utils.exceptions import EmptyCryptoPrimitiveStoreError
+
+
+class CryptoStore:
+    """FIFO store of precomputed triples keyed by (op, shapes, n_parties)."""
+
+    def __init__(self) -> None:
+        self._store: dict[tuple, list] = {}
+
+    @staticmethod
+    def key(op: str, shape_x: tuple, shape_y: tuple, n_parties: int) -> tuple:
+        return (op, tuple(shape_x), tuple(shape_y), n_parties)
+
+    def put(self, key: tuple, triple) -> None:
+        self._store.setdefault(key, []).append(triple)
+
+    def pop(self, key: tuple):
+        bucket = self._store.get(key)
+        if not bucket:
+            raise EmptyCryptoPrimitiveStoreError(
+                {
+                    "op": key[0],
+                    "shapes": [list(key[1]), list(key[2])],
+                    "n_instances": 1,
+                    "n_parties": key[3],
+                }
+            )
+        return bucket.pop(0)
+
+    def count(self, key: tuple) -> int:
+        return len(self._store.get(key, []))
+
+
+class CryptoProvider:
+    """Trusted-dealer triple service running on the accelerator.
+
+    ``strict_store=True`` reproduces the reference stack's refill behavior:
+    requests only draw from the precomputed store and raise
+    ``EmptyCryptoPrimitiveStoreError`` when dry (the caller then calls
+    :meth:`provide` to refill — the round-trip the reference's error path
+    serializes over the wire). Default mode generates on demand.
+    """
+
+    def __init__(
+        self, id: str = "crypto_provider", seed: int = 0, strict_store: bool = False
+    ) -> None:
+        self.id = id
+        self.store = CryptoStore()
+        self.strict_store = strict_store
+        self._key = jax.random.PRNGKey(seed)
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # --- triple generation --------------------------------------------------
+
+    def _make_triple(
+        self, op: str, shape_x: tuple, shape_y: tuple, n_parties: int
+    ) -> tuple[R.Ring64, R.Ring64, R.Ring64]:
+        ka, kb, ksa, ksb, ksc = jax.random.split(self._next_key(), 5)
+        a = R.ring_random(ka, tuple(shape_x))
+        b = R.ring_random(kb, tuple(shape_y))
+        if op == "mul":
+            c = R.ring_mul(a, b)
+        elif op == "matmul":
+            c = R.ring_matmul(a, b)
+        else:
+            raise ValueError(f"unknown triple op {op!r}")
+        return (
+            share_kernel(ksa, a, n_parties),
+            share_kernel(ksb, b, n_parties),
+            share_kernel(ksc, c, n_parties),
+        )
+
+    def provide(
+        self, op: str, shape_x: tuple, shape_y: tuple, n_parties: int,
+        n_instances: int = 1,
+    ) -> None:
+        """Refill the store (the response to an empty-store error)."""
+        key = CryptoStore.key(op, shape_x, shape_y, n_parties)
+        for _ in range(n_instances):
+            self.store.put(key, self._make_triple(op, shape_x, shape_y, n_parties))
+
+    def triple(
+        self, op: str, shape_x: tuple, shape_y: tuple, n_parties: int
+    ) -> tuple[R.Ring64, R.Ring64, R.Ring64]:
+        key = CryptoStore.key(op, shape_x, shape_y, n_parties)
+        if self.store.count(key):
+            return self.store.pop(key)
+        if self.strict_store:
+            return self.store.pop(key)  # raises EmptyCryptoPrimitiveStoreError
+        return self._make_triple(op, shape_x, shape_y, n_parties)
+
+    # --- provider-assisted exact truncation ---------------------------------
+
+    def reshare_truncated(
+        self, shares: R.Ring64, scale: int, n_parties: int
+    ) -> R.Ring64:
+        """Open → truncate exactly → re-share.
+
+        Simulation-grade truncation (the dealer sees the value): exact and
+        deterministic, which the protocol tests require. A deployment-grade
+        replacement is probabilistic share-local truncation or a share
+        conversion protocol; the call site is this one method.
+        """
+        truncated = R.ring_div_const_signed(reconstruct_kernel(shares), scale)
+        return share_kernel(self._next_key(), truncated, n_parties)
