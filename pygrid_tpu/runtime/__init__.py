@@ -1,0 +1,4 @@
+from pygrid_tpu.runtime.store import ObjectStore, StoredObject  # noqa: F401
+from pygrid_tpu.runtime.worker import VirtualWorker  # noqa: F401
+from pygrid_tpu.runtime.pointers import PointerTensor, send  # noqa: F401
+from pygrid_tpu.runtime import messages  # noqa: F401
