@@ -1,0 +1,10 @@
+from pygrid_tpu.federated.controller import FLController  # noqa: F401
+from pygrid_tpu.federated.cycle_manager import CycleManager  # noqa: F401
+from pygrid_tpu.federated.managers import (  # noqa: F401
+    ModelManager,
+    PlanManager,
+    ProcessManager,
+    ProtocolManager,
+    WorkerManager,
+)
+from pygrid_tpu.federated import auth, schemas, tasks  # noqa: F401

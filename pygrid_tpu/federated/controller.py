@@ -1,0 +1,133 @@
+"""FLController — process creation, worker→cycle assignment, diff intake.
+
+Parity surface: reference
+``model_centric/controller/fl_controller.py``: ``create_process`` (:23),
+``assign`` with dedup + eligibility + sha256 request key and the
+accept/reject response shapes (:82-172), ``submit_diff`` (:184).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import hashlib
+import uuid
+from typing import Any
+
+from pygrid_tpu.federated import schemas as S
+from pygrid_tpu.federated.cycle_manager import CycleManager
+from pygrid_tpu.federated.managers import (
+    ModelManager,
+    PlanManager,
+    ProcessManager,
+    ProtocolManager,
+    WorkerManager,
+)
+from pygrid_tpu.storage.warehouse import Database
+from pygrid_tpu.utils import exceptions as E
+from pygrid_tpu.utils.codes import CYCLE, MSG_FIELD
+
+
+class FLController:
+    def __init__(self, db: Database) -> None:
+        self.plan_manager = PlanManager(db)
+        self.protocol_manager = ProtocolManager(db)
+        self.process_manager = ProcessManager(
+            db, self.plan_manager, self.protocol_manager
+        )
+        self.model_manager = ModelManager(db)
+        self.worker_manager = WorkerManager(db)
+        self.cycle_manager = CycleManager(
+            db, self.process_manager, self.model_manager, self.plan_manager
+        )
+
+    # --- hosting ------------------------------------------------------------
+
+    def create_process(
+        self,
+        model_blob: bytes,
+        client_plans: dict[str, Any],
+        name: str,
+        version: str,
+        client_config: dict,
+        server_config: dict,
+        server_averaging_plan: Any = None,
+        client_protocols: dict[str, bytes] | None = None,
+    ) -> S.FLProcess:
+        """(reference :23-67) process + assets + configs + model + 1st cycle."""
+        process = self.process_manager.create(
+            name=name,
+            version=version,
+            client_plans=client_plans,
+            client_protocols=client_protocols or {},
+            server_averaging_plan=server_averaging_plan,
+            client_config=client_config,
+            server_config=server_config,
+        )
+        self.model_manager.create(model_blob, process)
+        self.cycle_manager.create(
+            process.id, version, server_config.get("cycle_length")
+        )
+        return process
+
+    # --- assignment ---------------------------------------------------------
+
+    @staticmethod
+    def _generate_hash_key() -> str:
+        return hashlib.sha256(uuid.uuid4().hex.encode()).hexdigest()
+
+    def last_cycle(self, name: str, version: str) -> tuple[S.FLProcess, S.Cycle]:
+        process = self.process_manager.first(name=name, version=version)
+        return process, self.cycle_manager.last(process.id)
+
+    def assign(self, name: str, version: str, worker: S.Worker) -> dict:
+        """Accept/reject a cycle request (reference :82-172)."""
+        process, cycle = self.last_cycle(name, version)
+        server_config = self.process_manager.get_configs(
+            fl_process_id=process.id, is_server_config=True
+        )
+
+        reject_reason = None
+        if self.cycle_manager.is_assigned(cycle.id, worker.id):
+            reject_reason = "already in cycle"
+        elif not self.worker_manager.is_eligible(worker, server_config):
+            reject_reason = "bandwidth"
+        else:
+            dont_reuse = server_config.get("do_not_reuse_workers_until_cycle")
+            if dont_reuse:
+                last_seq = self.cycle_manager.last_participation(
+                    process.id, worker.id
+                )
+                if last_seq > 0 and cycle.sequence < last_seq + dont_reuse:
+                    reject_reason = "reuse window"
+        if reject_reason is not None:
+            response: dict[str, Any] = {CYCLE.STATUS: CYCLE.REJECTED}
+            if cycle.end is not None:
+                remaining = (
+                    cycle.end
+                    - dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+                ).total_seconds()
+                response[CYCLE.TIMEOUT] = max(0, int(remaining))
+            return response
+
+        request_key = self._generate_hash_key()
+        self.cycle_manager.assign(cycle, worker.id, request_key)
+        model = self.model_manager.get(fl_process_id=process.id)
+        return {
+            CYCLE.STATUS: CYCLE.ACCEPTED,
+            CYCLE.KEY: request_key,
+            CYCLE.VERSION: cycle.version,
+            MSG_FIELD.MODEL_ID: model.id,
+            CYCLE.PLANS: self.process_manager.get_plans(process.id),
+            CYCLE.PROTOCOLS: self.process_manager.get_protocols(process.id),
+            CYCLE.CLIENT_CONFIG: self.process_manager.get_configs(
+                fl_process_id=process.id, is_server_config=False
+            ),
+            MSG_FIELD.MODEL: process.name,
+        }
+
+    # --- reporting ----------------------------------------------------------
+
+    def submit_diff(self, worker_id: str, request_key: str, diff: bytes) -> None:
+        if not request_key:
+            raise E.MissingRequestKeyError()
+        self.cycle_manager.submit_worker_diff(worker_id, request_key, diff)

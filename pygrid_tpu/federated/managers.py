@@ -1,0 +1,247 @@
+"""Process/Plan/Protocol/Model/Worker managers for model-centric FL.
+
+Parity surface: reference ``apps/node/src/app/main/model_centric/``:
+ProcessManager (``processes/process_manager.py:21-137``), PlanManager
+(``syft_assets/plan_manager.py:24-149``), ProtocolManager
+(``syft_assets/protocol_manager.py``), ModelManager
+(``models/model_manager.py:19-103``), WorkerManager
+(``workers/worker_manager.py:15-76``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pygrid_tpu.federated import schemas as S
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.plans.translators import translate_plan
+from pygrid_tpu.serde import deserialize, serialize
+from pygrid_tpu.storage.warehouse import Database, Warehouse
+from pygrid_tpu.utils import exceptions as E
+
+
+class PlanManager:
+    def __init__(self, db: Database) -> None:
+        self._plans = Warehouse(S.PlanRecord, db)
+
+    def register(
+        self, process: S.FLProcess, plans: dict[str, Plan | bytes], avg_plan: bool
+    ) -> None:
+        """Deserialize each uploaded plan and store its download variants
+        (reference trims to list/ts/tfjs at host time, plan_manager.py:24-59;
+        the avg plan is stored raw :57-59)."""
+        for name, plan in plans.items():
+            if isinstance(plan, (bytes, bytearray)):
+                plan = deserialize(bytes(plan))
+            if not isinstance(plan, Plan):
+                raise E.PlanInvalidError(f"plan {name!r} is not a Plan")
+            self._plans.register(
+                name=name,
+                value=serialize(translate_plan(plan, "list"))
+                if plan.oplist is not None
+                else b"",
+                value_xla=serialize(plan),
+                value_code=(plan.code or "").encode(),
+                is_avg_plan=avg_plan,
+                fl_process_id=process.id,
+            )
+
+    def get(self, **filters: Any) -> S.PlanRecord:
+        plan = self._plans.first(**filters)
+        if plan is None:
+            raise E.PlanNotFoundError()
+        return plan
+
+    def get_plans(self, **filters: Any) -> dict[str, int]:
+        return {p.name: p.id for p in self._plans.query(**filters)}
+
+    def get_variant(self, plan_id: int, variant: str) -> bytes:
+        """Serve one download variant (reference receive_operations_as ∈
+        {list, torchscript, tfjs} — routes.py:228-233)."""
+        plan = self.get(id=plan_id)
+        variant = {"torchscript": "xla", "tfjs": "code", "list": "list"}.get(
+            variant, variant
+        )
+        blob = {
+            "list": plan.value,
+            "xla": plan.value_xla,
+            "code": plan.value_code,
+        }.get(variant)
+        if blob is None:
+            raise E.PlanTranslationError(f"unknown plan variant {variant!r}")
+        if not blob:
+            raise E.PlanTranslationError(f"variant {variant!r} not stored")
+        return blob
+
+    def deserialize_plan(self, blob: bytes) -> Plan:
+        plan = deserialize(blob)
+        if not isinstance(plan, Plan):
+            raise E.PlanInvalidError()
+        return plan
+
+    def delete(self, **filters: Any) -> None:
+        self._plans.delete(**filters)
+
+
+class ProtocolManager:
+    """Protocols are opaque blobs; optional (aggregation ignores them —
+    reference cycle_manager.py:214)."""
+
+    def __init__(self, db: Database) -> None:
+        self._protocols = Warehouse(S.ProtocolRecord, db)
+
+    def register(self, process: S.FLProcess, protocols: dict[str, bytes]) -> None:
+        for name, value in protocols.items():
+            self._protocols.register(
+                name=name, value=bytes(value), fl_process_id=process.id
+            )
+
+    def get(self, **filters: Any) -> S.ProtocolRecord:
+        proto = self._protocols.first(**filters)
+        if proto is None:
+            raise E.ProtocolNotFoundError()
+        return proto
+
+    def get_protocols(self, **filters: Any) -> dict[str, int]:
+        return {p.name: p.id for p in self._protocols.query(**filters)}
+
+    def delete(self, **filters: Any) -> None:
+        self._protocols.delete(**filters)
+
+
+class ProcessManager:
+    def __init__(
+        self, db: Database, plan_manager: PlanManager, protocol_manager: ProtocolManager
+    ) -> None:
+        self._processes = Warehouse(S.FLProcess, db)
+        self._configs = Warehouse(S.Config, db)
+        self.plan_manager = plan_manager
+        self.protocol_manager = protocol_manager
+
+    def create(
+        self,
+        name: str,
+        version: str,
+        client_plans: dict[str, Any],
+        client_protocols: dict[str, bytes],
+        server_averaging_plan: Any,
+        client_config: dict,
+        server_config: dict,
+    ) -> S.FLProcess:
+        if self._processes.contains(name=name, version=version):
+            raise E.FLProcessConflict()
+        process = self._processes.register(name=name, version=version)
+        self.plan_manager.register(process, client_plans, avg_plan=False)
+        if server_averaging_plan is not None:
+            self.plan_manager.register(
+                process, {"averaging_plan": server_averaging_plan}, avg_plan=True
+            )
+        if client_protocols:
+            self.protocol_manager.register(process, client_protocols)
+        self._configs.register(
+            config=client_config, is_server_config=False, fl_process_id=process.id
+        )
+        self._configs.register(
+            config=server_config, is_server_config=True, fl_process_id=process.id
+        )
+        return process
+
+    def first(self, **filters: Any) -> S.FLProcess:
+        process = self._processes.first(**filters)
+        if process is None:
+            raise E.FLProcessNotFoundError()
+        return process
+
+    def get(self, **filters: Any) -> list[S.FLProcess]:
+        return self._processes.query(**filters)
+
+    def get_configs(self, fl_process_id: int, is_server_config: bool) -> dict:
+        cfg = self._configs.first(
+            fl_process_id=fl_process_id, is_server_config=is_server_config
+        )
+        if cfg is None:
+            raise E.ConfigsNotFoundError()
+        return cfg.config
+
+    def get_plans(self, fl_process_id: int, is_avg_plan: bool = False) -> dict:
+        return self.plan_manager.get_plans(
+            fl_process_id=fl_process_id, is_avg_plan=is_avg_plan
+        )
+
+    def get_protocols(self, fl_process_id: int) -> dict:
+        return self.protocol_manager.get_protocols(fl_process_id=fl_process_id)
+
+    def delete(self, **filters: Any) -> None:
+        for process in self._processes.query(**filters):
+            self.plan_manager.delete(fl_process_id=process.id)
+            self.protocol_manager.delete(fl_process_id=process.id)
+            self._configs.delete(fl_process_id=process.id)
+        self._processes.delete(**filters)
+
+
+class ModelManager:
+    def __init__(self, db: Database) -> None:
+        self._models = Warehouse(S.Model, db)
+        self._checkpoints = Warehouse(S.ModelCheckPoint, db)
+
+    def create(self, model_params_blob: bytes, process: S.FLProcess) -> S.Model:
+        model = self._models.register(
+            version=process.version, fl_process_id=process.id
+        )
+        self.save(model.id, model_params_blob)
+        return model
+
+    def get(self, **filters: Any) -> S.Model:
+        model = self._models.first(**filters)
+        if model is None:
+            raise E.ModelNotFoundError()
+        return model
+
+    def save(self, model_id: int, blob: bytes) -> S.ModelCheckPoint:
+        """New checkpoint; re-aliases "latest" (reference
+        model_manager.py:30-50)."""
+        self._checkpoints.modify({"model_id": model_id, "alias": "latest"}, {"alias": ""})
+        number = self._checkpoints.count(model_id=model_id) + 1
+        return self._checkpoints.register(
+            value=blob, model_id=model_id, number=number, alias="latest"
+        )
+
+    def load(self, **filters: Any) -> S.ModelCheckPoint:
+        ckpt = self._checkpoints.last(**filters)
+        if ckpt is None:
+            raise E.CheckPointNotFound()
+        return ckpt
+
+
+class WorkerManager:
+    def __init__(self, db: Database) -> None:
+        self._workers = Warehouse(S.Worker, db)
+
+    def create(self, worker_id: str) -> S.Worker:
+        return self._workers.register(id=worker_id)
+
+    def get(self, **filters: Any) -> S.Worker:
+        worker = self._workers.first(**filters)
+        if worker is None:
+            raise E.WorkerNotFoundError()
+        return worker
+
+    def update(self, worker: S.Worker) -> None:
+        self._workers.modify(
+            {"id": worker.id},
+            {
+                "ping": worker.ping,
+                "avg_download": worker.avg_download,
+                "avg_upload": worker.avg_upload,
+            },
+        )
+
+    def is_eligible(self, worker: S.Worker, server_config: dict) -> bool:
+        """Bandwidth gating (reference worker_manager.py:52-76)."""
+        min_upload = server_config.get("minimum_upload_speed")
+        min_download = server_config.get("minimum_download_speed")
+        if min_upload is not None and (worker.avg_upload or 0) < min_upload:
+            return False
+        if min_download is not None and (worker.avg_download or 0) < min_download:
+            return False
+        return True

@@ -1,0 +1,101 @@
+"""Model-centric FL database schemas.
+
+Parity surface (names, fields, relationships): reference ORM models under
+``apps/node/src/app/main/model_centric/`` — FLProcess
+(``processes/fl_process.py:4-34``), Config (``processes/config.py:4-23``),
+Cycle (``cycles/cycle.py:4-29``), WorkerCycle (``cycles/worker_cycle.py:8-31``),
+Worker (``workers/worker.py:4-25``), Model/ModelCheckPoint
+(``models/ai_model.py:8-57``), Plan (``syft_assets/plan.py:4-29``), Protocol
+(``syft_assets/protocol.py:4-25``).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FLProcess:
+    id: int | None = None
+    name: str = ""
+    version: str = ""
+
+
+@dataclass
+class Config:
+    id: int | None = None
+    config: dict = field(default_factory=dict)
+    is_server_config: bool = False
+    fl_process_id: int = 0
+
+
+@dataclass
+class Cycle:
+    id: int | None = None
+    fl_process_id: int = 0
+    sequence: int = 0
+    version: str = ""
+    start: dt.datetime | None = None
+    end: dt.datetime | None = None
+    is_completed: bool = False
+
+
+@dataclass
+class WorkerCycle:
+    id: int | None = None
+    cycle_id: int = 0
+    worker_id: str = ""
+    request_key: str = ""
+    started_at: dt.datetime | None = None
+    is_completed: bool = False
+    completed_at: dt.datetime | None = None
+    diff: bytes | None = None
+
+
+@dataclass
+class Worker:
+    """FL client registry entry. String primary key (uuid worker_id)."""
+
+    id: str = ""
+    ping: float | None = None
+    avg_download: float | None = None
+    avg_upload: float | None = None
+
+
+@dataclass
+class Model:
+    id: int | None = None
+    version: str = ""
+    fl_process_id: int = 0
+
+
+@dataclass
+class ModelCheckPoint:
+    id: int | None = None
+    value: bytes = b""
+    model_id: int = 0
+    number: int = 0
+    alias: str = ""
+
+
+@dataclass
+class PlanRecord:
+    """Stored plan with its three download variants (reference Plan schema's
+    value/value_ts/value_tfjs blobs → value/value_xla/value_code)."""
+
+    id: int | None = None
+    name: str = ""
+    value: bytes = b""          # portable op-list variant, serialized
+    value_xla: bytes = b""      # exported StableHLO variant (torchscript slot)
+    value_code: bytes = b""     # readable jaxpr text (tfjs slot)
+    is_avg_plan: bool = False
+    fl_process_id: int = 0
+
+
+@dataclass
+class ProtocolRecord:
+    id: int | None = None
+    name: str = ""
+    value: bytes = b""
+    fl_process_id: int = 0

@@ -1,0 +1,48 @@
+"""Deduplicated background task execution.
+
+Parity surface: reference ``model_centric/tasks/cycle.py:9-37`` —
+``run_task_once`` prevents concurrent ``complete_cycle`` runs for the same
+key on the Flask-Executor pool. Here a plain thread + an in-flight key set;
+``set_sync(True)`` makes execution synchronous (tests, and the asyncio node
+app which supplies its own executor).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+_in_flight: set[str] = set()
+_lock = threading.Lock()
+_sync = False
+
+
+def set_sync(sync: bool) -> None:
+    global _sync
+    _sync = sync
+
+
+def run_task_once(key: str, fn: Callable, *args: Any) -> None:
+    """Run ``fn(*args)`` unless a task with ``key`` is already in flight."""
+    with _lock:
+        if key in _in_flight:
+            logger.debug("task %s already in flight — skipped", key)
+            return
+        _in_flight.add(key)
+
+    def _run() -> None:
+        try:
+            fn(*args)
+        except Exception:  # noqa: BLE001 — background boundary, must not die silently
+            logger.exception("background task %s failed", key)
+        finally:
+            with _lock:
+                _in_flight.discard(key)
+
+    if _sync:
+        _run()
+    else:
+        threading.Thread(target=_run, name=f"task-{key}", daemon=True).start()

@@ -1,0 +1,71 @@
+"""MNIST CNN — the reference's data-centric example model.
+
+Parity surface: the conv net in reference
+``examples/data-centric/mnist/02-FL-mnist-train-model.ipynb`` (cell 11:
+conv(1→32,3x3) → conv(32→64,3x3) → maxpool2 → fc(9216→128) → fc(128→10)).
+
+NHWC layout (TPU-native; the reference's NCHW is a torch convention, not a
+capability).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init(key: jax.Array) -> list[jax.Array]:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return [
+        jax.random.normal(k1, (3, 3, 1, 32)) * (2.0 / 9) ** 0.5,
+        jnp.zeros((32,)),
+        jax.random.normal(k2, (3, 3, 32, 64)) * (2.0 / (9 * 32)) ** 0.5,
+        jnp.zeros((64,)),
+        jax.random.normal(k3, (9216, 128)) * (2.0 / 9216) ** 0.5,
+        jnp.zeros((128,)),
+        jax.random.normal(k4, (128, 10)) * (2.0 / 128) ** 0.5,
+        jnp.zeros((10,)),
+    ]
+
+
+def _conv(x: jax.Array, w: jax.Array) -> jax.Array:
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def apply(params: Sequence[jax.Array], X: jax.Array) -> jax.Array:
+    """X: [N, 28, 28, 1] → logits [N, 10]."""
+    w1, b1, w2, b2, w3, b3, w4, b4 = params
+    h = jnp.maximum(_conv(X, w1) + b1, 0.0)          # [N,26,26,32]
+    h = jnp.maximum(_conv(h, w2) + b2, 0.0)          # [N,24,24,64]
+    h = lax.reduce_window(                            # maxpool 2x2
+        h, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )                                                 # [N,12,12,64]
+    h = h.reshape(h.shape[0], -1)                     # [N,9216]
+    h = jnp.maximum(h @ w3 + b3, 0.0)
+    return h @ w4 + b4
+
+
+def loss_and_acc(params, X, y):
+    logits = apply(params, X)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.sum(y * logp, axis=-1))
+    acc = jnp.mean(
+        (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
+    )
+    return loss, acc
+
+
+def training_step(X, y, lr, *params):
+    def loss_fn(p):
+        return loss_and_acc(p, X, y)[0]
+
+    loss, grads = jax.value_and_grad(loss_fn)(list(params))
+    new_params = [p - lr * g for p, g in zip(params, grads)]
+    _, acc = loss_and_acc(list(params), X, y)
+    return (loss, acc, *new_params)

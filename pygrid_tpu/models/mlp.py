@@ -1,0 +1,58 @@
+"""MNIST MLP — the reference's model-centric example model.
+
+Parity surface: the 784→392→10 two-layer MLP traced into the training plan in
+reference ``examples/model-centric/01-Create-plan.ipynb`` (cell 10: Net with
+fc1/fc2, cell 16: softmax-CE + SGD training plan with accuracy output).
+
+Pure-functional: ``init`` → param list, ``apply`` → logits, ``training_step``
+mirrors the reference plan signature (X, y, batch_size, lr, *params) →
+(loss, acc, *new_params) so it can be traced into a Plan, vmapped over a
+client axis, or shard_mapped over a mesh unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init(key: jax.Array, sizes: Sequence[int] = (784, 392, 10)) -> list[jax.Array]:
+    params: list[jax.Array] = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, n_in, n_out in zip(keys, sizes[:-1], sizes[1:]):
+        params.append(jax.random.normal(k, (n_in, n_out)) * (2.0 / n_in) ** 0.5)
+        params.append(jnp.zeros((n_out,)))
+    return params
+
+
+def apply(params: Sequence[jax.Array], X: jax.Array) -> jax.Array:
+    h = X
+    for i in range(0, len(params) - 2, 2):
+        h = jnp.maximum(h @ params[i] + params[i + 1], 0.0)
+    return h @ params[-2] + params[-1]
+
+
+def loss_and_acc(params: Sequence[jax.Array], X: jax.Array, y: jax.Array):
+    """Softmax cross-entropy (y one-hot) + accuracy — the reference plan's
+    loss/acc pair (01-Create-plan.ipynb cell 16)."""
+    logits = apply(params, X)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.sum(y * logp, axis=-1))
+    acc = jnp.mean(
+        (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
+    )
+    return loss, acc
+
+
+def training_step(X, y, lr, *params):
+    """One SGD step; traceable into a Plan (reference plan signature)."""
+
+    def loss_fn(p):
+        return loss_and_acc(p, X, y)[0]
+
+    loss, grads = jax.value_and_grad(loss_fn)(list(params))
+    new_params = [p - lr * g for p, g in zip(params, grads)]
+    _, acc = loss_and_acc(list(params), X, y)
+    return (loss, acc, *new_params)

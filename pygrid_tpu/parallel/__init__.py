@@ -1,0 +1,11 @@
+from pygrid_tpu.parallel.mesh import (  # noqa: F401
+    client_sharding,
+    initialize_distributed,
+    make_mesh,
+    replicated,
+)
+from pygrid_tpu.parallel.fedavg import (  # noqa: F401
+    make_round,
+    make_sharded_round,
+    run_rounds,
+)

@@ -1,0 +1,135 @@
+"""FedAvg simulation engine — vmapped clients, psum aggregation.
+
+This is the TPU-native replacement for the reference's per-client socket
+round-trip (SURVEY.md §3.3: each worker downloads the model, runs the
+training plan locally, reports a diff; the node averages with a Python
+reduce loop — cycle_manager.py:275-290):
+
+- K simulated clients are a **leading array axis** — their local training is
+  one ``vmap``-ed program, their "reports" never leave HBM.
+- On a device mesh the client axis is **sharded**; the average is a
+  ``psum``/``pmean`` over the ``"clients"`` mesh axis riding ICI
+  (:func:`make_sharded_round` via ``shard_map``).
+- One FedAvg round — local steps, diffing, aggregation, model update — is a
+  single compiled XLA program either way.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+
+def _client_update(
+    training_step: Callable, params: Sequence, X, y, lr, local_steps: int
+):
+    """One client's local training: ``local_steps`` SGD steps via scan."""
+
+    def body(p, _):
+        out = training_step(X, y, lr, *p)
+        return list(out[2:]), (out[0], out[1])
+
+    new_params, (losses, accs) = lax.scan(
+        body, list(params), None, length=local_steps
+    )
+    return new_params, losses[-1], accs[-1]
+
+
+def make_round(
+    training_step: Callable, local_steps: int = 1
+) -> Callable:
+    """Build a jitted FedAvg round over a vmapped client axis.
+
+    Returns ``round_fn(params, client_X [K,...], client_y [K,...], lr) ->
+    (new_params, mean_loss, mean_acc)``. The new global params equal
+    ``params - mean_k(diff_k)`` (reference cycle_manager.py:295-298).
+    """
+
+    @jax.jit
+    def round_fn(params, client_X, client_y, lr):
+        def one_client(X, y):
+            new_p, loss, acc = _client_update(
+                training_step, params, X, y, lr, local_steps
+            )
+            diffs = [p - n for p, n in zip(params, new_p)]
+            return diffs, loss, acc
+
+        diffs, losses, accs = jax.vmap(one_client)(client_X, client_y)
+        avg_diff = [jnp.mean(d, axis=0) for d in diffs]
+        new_params = [p - d for p, d in zip(params, avg_diff)]
+        return new_params, jnp.mean(losses), jnp.mean(accs)
+
+    return round_fn
+
+
+def make_sharded_round(
+    training_step: Callable,
+    mesh: Mesh,
+    local_steps: int = 1,
+    axis: str = "clients",
+) -> Callable:
+    """FedAvg round with the client axis sharded over the mesh.
+
+    Each device trains its shard of clients (vmap inside the shard), then the
+    global average diff is a ``pmean`` over the mesh axis — the collective
+    rides ICI instead of the reference's socket fan-in. Params/results are
+    replicated; client data is sharded on its leading axis.
+    """
+
+    def shard_fn(params, client_X, client_y, lr):
+        # Mark params/lr device-varying: under shard_map's replication-aware
+        # autodiff, grads w.r.t. REPLICATED values get an implicit psum
+        # across the mesh (replicated cotangent rule) — which would silently
+        # aggregate every client's gradient into each local step. pcast
+        # keeps local training local; only the explicit pmean below crosses
+        # devices.
+        params_v = [lax.pcast(p, axis, to="varying") for p in params]
+        lr_v = lax.pcast(lr, axis, to="varying")
+
+        def one_client(X, y):
+            new_p, loss, acc = _client_update(
+                training_step, params_v, X, y, lr_v, local_steps
+            )
+            return [p - n for p, n in zip(params_v, new_p)], loss, acc
+
+        diffs, losses, accs = jax.vmap(one_client)(client_X, client_y)
+        # local mean then pmean over the mesh axis == global mean (equal
+        # shard sizes — enforced by the sharding)
+        local_avg = [jnp.mean(d, axis=0) for d in diffs]
+        avg_diff = [lax.pmean(d, axis) for d in local_avg]
+        new_params = [p - d for p, d in zip(params, avg_diff)]
+        return new_params, lax.pmean(jnp.mean(losses), axis), lax.pmean(
+            jnp.mean(accs), axis
+        )
+
+    n_params_spec = P()
+    sharded = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(n_params_spec, P(axis), P(axis), n_params_spec),
+        out_specs=(n_params_spec, n_params_spec, n_params_spec),
+    )
+    return jax.jit(sharded)
+
+
+def run_rounds(
+    round_fn: Callable,
+    params: Sequence,
+    client_X,
+    client_y,
+    lr,
+    n_rounds: int,
+):
+    """Drive n FedAvg rounds host-side (each round one XLA launch)."""
+    metrics = []
+    for _ in range(n_rounds):
+        params, loss, acc = round_fn(params, client_X, client_y, lr)
+        metrics.append((loss, acc))
+    return params, metrics

@@ -1,0 +1,67 @@
+"""Device mesh construction for the simulated grid.
+
+The reference's "cluster" is N Flask processes on localhost (its
+tests/conftest.py spawns alice..dan); the TPU-native cluster is a
+``jax.sharding.Mesh`` whose axes carry the grid's parallel dimensions:
+
+- ``"clients"`` — federated participants (the reference's concurrency is
+  per-worker sockets; here a sharded batch axis, aggregation via psum on ICI)
+- ``"model"``  — optional tensor parallelism for large models (absent in the
+  reference — SURVEY.md §2.5 — pjit gives it for free)
+
+Multi-host: ``initialize_distributed`` wires jax.distributed so the same mesh
+spans hosts over DCN (the NCCL/MPI-backend analog, SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    axes: tuple[str, ...] = ("clients",),
+    shape: tuple[int, ...] | None = None,
+) -> Mesh:
+    """Mesh over (a prefix of) the available devices.
+
+    Default: all devices on one ``"clients"`` axis. ``shape`` splits them
+    over several axes, e.g. ``axes=("clients", "model"), shape=(4, 2)``.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if shape is None:
+        shape = (n,)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    return Mesh(np.asarray(devices[:n]).reshape(shape), axes)
+
+
+def client_sharding(mesh: Mesh, axis: str = "clients") -> NamedSharding:
+    """Leading-axis sharding: one shard of clients per device."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bring-up (jax.distributed over DCN). No-op when the
+    JAX_COORDINATOR env/args are absent — single-host stays zero-config."""
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR")
+    if not coordinator_address:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes or int(os.environ.get("JAX_NUM_PROCESSES", 1)),
+        process_id=process_id or int(os.environ.get("JAX_PROCESS_ID", 0)),
+    )

@@ -145,6 +145,10 @@ class VirtualWorker:
     # --- handlers -----------------------------------------------------------
 
     def _handle_object(self, msg: M.ObjectMessage, user: str | None):
+        if msg.id is not None and msg.id in self.store:
+            # client-chosen ids must not silently replace existing objects
+            # (poisoning another user's stored data)
+            raise E.PyGridError(f"object id {msg.id} already in use")
         obj = self.store.set_obj(
             value=msg.obj,
             id=msg.id,
@@ -167,7 +171,10 @@ class VirtualWorker:
         return value
 
     def _handle_delete(self, msg: M.ForceObjectDeleteMessage, user: str | None):
-        self.store.rm_obj(msg.obj_id)
+        if msg.obj_id in self.store:
+            # the destructive path is permission-gated like the read path
+            self.store.get_obj(msg.obj_id).check_access(user)
+            self.store.rm_obj(msg.obj_id)
         return {"status": "ok"}
 
     def _handle_command(self, msg: M.TensorCommandMessage, user: str | None):
@@ -234,12 +241,18 @@ class VirtualWorker:
 
     def _handle_run_plan(self, msg: M.RunPlanMessage, user: str | None):
         obj = self.store.get_obj(msg.plan_id)
+        obj.check_access(user)  # a private Plan is a private model
         plan = obj.value
         if not isinstance(plan, Plan):
             raise E.PlanNotFoundError(f"object {msg.plan_id} is not a Plan")
-        args = [self._resolve(a, user) for a in msg.args]
+        sources: list = [obj]
+        args = [self._resolve(a, user, sources) for a in msg.args]
         result = plan(*args)
-        stored = self.store.set_obj(result, id=msg.return_id)
+        stored = self.store.set_obj(
+            result,
+            id=msg.return_id,
+            allowed_users=self._derived_permissions(sources),
+        )
         return M.PointerResponse(
             id_at_location=stored.id,
             location=self.id,

@@ -61,11 +61,21 @@ class CryptoProvider:
     """
 
     def __init__(
-        self, id: str = "crypto_provider", seed: int = 0, strict_store: bool = False
+        self,
+        id: str = "crypto_provider",
+        seed: int | None = None,
+        strict_store: bool = False,
     ) -> None:
         self.id = id
         self.store = CryptoStore()
         self.strict_store = strict_store
+        if seed is None:
+            # triple secrecy rests on this randomness: a fixed default seed
+            # would make every dealer's a/b stream publicly reproducible and
+            # the Beaver open d = x - a would reveal x
+            import secrets
+
+            seed = secrets.randbits(63)
         self._key = jax.random.PRNGKey(seed)
 
     def _next_key(self) -> jax.Array:

@@ -1,0 +1,1 @@
+from pygrid_tpu.storage.warehouse import Database, Warehouse  # noqa: F401

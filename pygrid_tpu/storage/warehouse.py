@@ -1,0 +1,221 @@
+"""Warehouse — a generic repository over sqlite3.
+
+Parity surface: the reference's ``Warehouse(schema)`` generic ORM wrapper
+(``apps/node/src/app/main/core/warehouse.py:6-92``:
+register/query/first/last/count/contains/delete/modify/update over any
+SQLAlchemy schema). Here schemas are plain dataclasses (no SQLAlchemy in the
+image); column DDL is derived from dataclass field types, dict fields are
+stored as serde blobs (the reference's PickleType analog), and one
+``Database`` owns a thread-safe sqlite3 connection (in-memory by default —
+the reference's test posture — or a file for durability).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as dt
+import sqlite3
+import threading
+from typing import Any, Iterator, Type, TypeVar
+
+from pygrid_tpu.serde import deserialize, serialize
+
+T = TypeVar("T")
+
+_SQL_TYPES = {
+    int: "INTEGER",
+    float: "REAL",
+    str: "TEXT",
+    bool: "INTEGER",
+    bytes: "BLOB",
+    dict: "BLOB",
+    dt.datetime: "TEXT",
+}
+
+
+def _column_type(py_type: Any) -> str:
+    # unwrap Optional[...] / "X | None" annotations
+    for t, sql in _SQL_TYPES.items():
+        if py_type is t:
+            return sql
+        name = getattr(py_type, "__name__", str(py_type))
+        if name == t.__name__ or str(py_type).replace(" | None", "") in (
+            t.__name__,
+            f"datetime.{t.__name__}",
+        ):
+            return sql
+    return "BLOB"
+
+
+def _encode(value: Any, py_type: Any) -> Any:
+    if value is None:
+        return None
+    if isinstance(value, dict):
+        return serialize(value)
+    if isinstance(value, dt.datetime):
+        return value.isoformat()
+    if isinstance(value, bool):
+        return int(value)
+    return value
+
+
+def _decode(value: Any, py_type: Any) -> Any:
+    if value is None:
+        return None
+    type_str = str(py_type)
+    if "dict" in type_str and isinstance(value, bytes):
+        return deserialize(value)
+    if "datetime" in type_str and isinstance(value, str):
+        return dt.datetime.fromisoformat(value)
+    if "bool" in type_str:
+        return bool(value)
+    return value
+
+
+class Database:
+    """One sqlite connection + the table registry, shared by all warehouses."""
+
+    def __init__(self, url: str = ":memory:") -> None:
+        if url.startswith("sqlite://"):
+            url = url[len("sqlite://") :].lstrip("/") or ":memory:"
+        self._conn = sqlite3.connect(url, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.RLock()
+
+    def execute(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
+        with self._lock:
+            cur = self._conn.execute(sql, params)
+            self._conn.commit()
+            return cur
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class Warehouse:
+    """Typed repository for one dataclass schema.
+
+    The schema's first field named ``id`` is the primary key; ``int`` ids
+    autoincrement, ``str`` ids are caller-assigned (the reference's Worker
+    uses string ids — ``workers/worker.py:4-25``).
+    """
+
+    def __init__(self, schema: Type[T], db: Database) -> None:
+        if not dataclasses.is_dataclass(schema):
+            raise TypeError("Warehouse schema must be a dataclass")
+        self.schema = schema
+        self.db = db
+        self.table = schema.__name__.lower()
+        self.fields = dataclasses.fields(schema)
+        self._field_types = {f.name: f.type for f in self.fields}
+        self._create_table()
+
+    def _create_table(self) -> None:
+        cols = []
+        for f in self.fields:
+            col = f"{f.name} {_column_type(f.type)}"
+            if f.name == "id":
+                if _column_type(f.type) == "INTEGER":
+                    col = "id INTEGER PRIMARY KEY AUTOINCREMENT"
+                else:
+                    col = "id TEXT PRIMARY KEY"
+            cols.append(col)
+        self.db.execute(
+            f"CREATE TABLE IF NOT EXISTS {self.table} ({', '.join(cols)})"
+        )
+
+    # --- write --------------------------------------------------------------
+
+    def register(self, **kwargs: Any) -> T:
+        obj = self.schema(**kwargs)
+        names, values = [], []
+        for f in self.fields:
+            v = getattr(obj, f.name)
+            if f.name == "id" and v is None:
+                continue
+            names.append(f.name)
+            values.append(_encode(v, f.type))
+        sql = (
+            f"INSERT INTO {self.table} ({', '.join(names)}) "
+            f"VALUES ({', '.join('?' * len(names))})"
+        )
+        cur = self.db.execute(sql, tuple(values))
+        if getattr(obj, "id", None) is None:
+            object.__setattr__(obj, "id", cur.lastrowid)
+        return obj
+
+    def modify(self, filters: dict, updates: dict) -> None:
+        where, params = self._where(filters)
+        sets = ", ".join(f"{k} = ?" for k in updates)
+        set_params = tuple(
+            _encode(v, self._field_types.get(k)) for k, v in updates.items()
+        )
+        self.db.execute(
+            f"UPDATE {self.table} SET {sets}{where}", set_params + params
+        )
+
+    update = modify  # reference exposes both spellings
+
+    def delete(self, **filters: Any) -> None:
+        where, params = self._where(filters)
+        self.db.execute(f"DELETE FROM {self.table}{where}", params)
+
+    # --- read ---------------------------------------------------------------
+
+    def _where(self, filters: dict) -> tuple[str, tuple]:
+        if not filters:
+            return "", ()
+        clauses, params = [], []
+        for k, v in filters.items():
+            if v is None:
+                clauses.append(f"{k} IS NULL")
+            else:
+                clauses.append(f"{k} = ?")
+                params.append(_encode(v, self._field_types.get(k)))
+        return " WHERE " + " AND ".join(clauses), tuple(params)
+
+    def _row_to_obj(self, row: sqlite3.Row) -> T:
+        kwargs = {
+            f.name: _decode(row[f.name], f.type)
+            for f in self.fields
+            if f.name in row.keys()
+        }
+        return self.schema(**kwargs)
+
+    def query(self, order_by: str | None = None, **filters: Any) -> list[T]:
+        where, params = self._where(filters)
+        order = f" ORDER BY {order_by}" if order_by else ""
+        cur = self.db.execute(
+            f"SELECT * FROM {self.table}{where}{order}", params
+        )
+        return [self._row_to_obj(r) for r in cur.fetchall()]
+
+    def first(self, **filters: Any) -> T | None:
+        where, params = self._where(filters)
+        cur = self.db.execute(
+            f"SELECT * FROM {self.table}{where} LIMIT 1", params
+        )
+        row = cur.fetchone()
+        return self._row_to_obj(row) if row else None
+
+    def last(self, **filters: Any) -> T | None:
+        where, params = self._where(filters)
+        cur = self.db.execute(
+            f"SELECT * FROM {self.table}{where} ORDER BY rowid DESC LIMIT 1",
+            params,
+        )
+        row = cur.fetchone()
+        return self._row_to_obj(row) if row else None
+
+    def count(self, **filters: Any) -> int:
+        where, params = self._where(filters)
+        cur = self.db.execute(
+            f"SELECT COUNT(*) AS n FROM {self.table}{where}", params
+        )
+        return int(cur.fetchone()["n"])
+
+    def contains(self, **filters: Any) -> bool:
+        return self.count(**filters) > 0
+
+    def __iter__(self) -> Iterator[T]:
+        return iter(self.query())

@@ -1,0 +1,276 @@
+"""Model-centric FL coordination plane — mirrors the protocol semantics of
+reference tests/model_centric/test_fl_process.py (host → authenticate →
+cycle-request → report → aggregate) without the WS transport (integration
+tests add it)."""
+
+import datetime as dt
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pygrid_tpu.federated import FLController, auth as fed_auth, tasks
+from pygrid_tpu.federated import schemas as S
+from pygrid_tpu.plans import Plan
+from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
+from pygrid_tpu.storage import Database
+from pygrid_tpu.utils.codes import CYCLE
+from pygrid_tpu.utils.exceptions import (
+    AuthorizationError,
+    FLProcessConflict,
+    InvalidRequestKeyError,
+)
+
+tasks.set_sync(True)  # deterministic cycle completion in tests
+
+
+def _model_params():
+    rng = np.random.RandomState(0)
+    return [
+        rng.randn(10, 4).astype(np.float32) * 0.1,
+        np.zeros(4, np.float32),
+    ]
+
+
+def _training_plan():
+    def step(X, y, lr, w, b):
+        def loss_fn(p):
+            w_, b_ = p
+            pred = X @ w_ + b_
+            return jnp.mean((pred - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)((w, b))
+        return loss, w - lr * g[0], b - lr * g[1]
+
+    plan = Plan(name="training_plan", fn=step)
+    plan.build(
+        np.zeros((8, 10), np.float32),
+        np.zeros((8, 4), np.float32),
+        np.float32(0.1),
+        *_model_params(),
+    )
+    return plan
+
+
+SERVER_CONFIG = {
+    "min_workers": 2,
+    "max_workers": 5,
+    "num_cycles": 2,
+    "cycle_length": None,
+    "max_diffs": 2,
+    "min_diffs": 2,
+    "minimum_upload_speed": 0,
+    "minimum_download_speed": 0,
+}
+CLIENT_CONFIG = {
+    "name": "mnist", "version": "1.0", "batch_size": 8, "lr": 0.1,
+    "max_updates": 2,
+}
+
+
+@pytest.fixture()
+def controller():
+    db = Database(":memory:")
+    ctl = FLController(db)
+    ctl.create_process(
+        model_blob=serialize_model_params(_model_params()),
+        client_plans={"training_plan": _training_plan()},
+        name="mnist",
+        version="1.0",
+        client_config=dict(CLIENT_CONFIG),
+        server_config=dict(SERVER_CONFIG),
+    )
+    return ctl
+
+
+def _register_worker(ctl, wid, upload=100.0, download=100.0):
+    w = ctl.worker_manager.create(wid)
+    w.avg_upload, w.avg_download, w.ping = upload, download, 1.0
+    ctl.worker_manager.update(w)
+    return ctl.worker_manager.get(id=wid)
+
+
+def test_host_conflict(controller):
+    with pytest.raises(FLProcessConflict):
+        controller.create_process(
+            model_blob=b"x",
+            client_plans={"p": _training_plan()},
+            name="mnist",
+            version="1.0",
+            client_config={},
+            server_config={},
+        )
+
+
+def test_assign_accept_shape(controller):
+    w = _register_worker(controller, "w1")
+    resp = controller.assign("mnist", "1.0", w)
+    assert resp[CYCLE.STATUS] == CYCLE.ACCEPTED
+    assert len(resp[CYCLE.KEY]) == 64  # sha256 hex
+    assert "training_plan" in resp[CYCLE.PLANS]
+    assert resp[CYCLE.CLIENT_CONFIG]["batch_size"] == 8
+
+
+def test_assign_dedup_rejected(controller):
+    w = _register_worker(controller, "w1")
+    assert controller.assign("mnist", "1.0", w)[CYCLE.STATUS] == CYCLE.ACCEPTED
+    assert controller.assign("mnist", "1.0", w)[CYCLE.STATUS] == CYCLE.REJECTED
+
+
+def test_assign_bandwidth_rejected(controller):
+    slow = _register_worker(controller, "slow", upload=0.1, download=0.1)
+    cfg = controller.process_manager.get_configs(
+        fl_process_id=1, is_server_config=True
+    )
+    cfg["minimum_upload_speed"] = 2.0
+    cfg["minimum_download_speed"] = 4.0
+    controller.process_manager._configs.modify(
+        {"fl_process_id": 1, "is_server_config": True}, {"config": cfg}
+    )
+    assert controller.assign("mnist", "1.0", slow)[CYCLE.STATUS] == CYCLE.REJECTED
+
+
+def test_invalid_request_key(controller):
+    _register_worker(controller, "w1")
+    with pytest.raises(InvalidRequestKeyError):
+        controller.submit_diff("w1", "bogus", b"diff")
+
+
+def _one_round(ctl, worker_ids, lr=0.1):
+    """Run one full cycle: each worker trains locally and reports a diff."""
+    accepted = {}
+    for wid in worker_ids:
+        w = _register_worker(ctl, wid)
+        resp = ctl.assign("mnist", "1.0", w)
+        if resp[CYCLE.STATUS] == CYCLE.ACCEPTED:
+            accepted[wid] = resp
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(8, 10).astype(np.float32)
+    true_w = rng.randn(10, 4).astype(np.float32)
+    y = X @ true_w
+
+    for wid, resp in accepted.items():
+        ckpt = ctl.model_manager.load(model_id=resp["model_id"], alias="latest")
+        params = unserialize_model_params(ckpt.value)
+        plan_blob = ctl.plan_manager.get_variant(
+            resp[CYCLE.PLANS]["training_plan"], "torchscript"
+        )
+        plan = ctl.plan_manager.deserialize_plan(plan_blob)
+        loss, new_w, new_b = plan(X, y, np.float32(lr), *params)
+        diff = [
+            np.asarray(p) - np.asarray(n) for p, n in zip(params, (new_w, new_b))
+        ]
+        ctl.submit_diff(wid, resp[CYCLE.KEY], serialize_model_params(diff))
+    return accepted
+
+
+def test_full_fedavg_round_updates_checkpoint(controller):
+    before = controller.model_manager.load(model_id=1, alias="latest")
+    _one_round(controller, ["w1", "w2"])
+    after = controller.model_manager.load(model_id=1, alias="latest")
+    assert after.number == before.number + 1 and after.alias == "latest"
+    p_before = unserialize_model_params(before.value)
+    p_after = unserialize_model_params(after.value)
+    assert not np.allclose(p_before[0], p_after[0])  # params moved
+    # next cycle spawned
+    cycle = controller.cycle_manager.last(1)
+    assert cycle.sequence == 2
+
+
+def test_fedavg_learns(controller):
+    """Two FedAvg rounds reduce the loss on the shared objective."""
+    rng = np.random.RandomState(42)
+    X = rng.randn(8, 10).astype(np.float32)
+    true_w = rng.randn(10, 4).astype(np.float32)
+    y = X @ true_w
+
+    def loss_of(params):
+        return float(np.mean((X @ params[0] + params[1] - y) ** 2))
+
+    l0 = loss_of(
+        unserialize_model_params(
+            controller.model_manager.load(model_id=1, alias="latest").value
+        )
+    )
+    _one_round(controller, ["w1", "w2"])
+    _one_round(controller, ["w3", "w4"])
+    l2 = loss_of(
+        unserialize_model_params(
+            controller.model_manager.load(model_id=1, alias="latest").value
+        )
+    )
+    assert l2 < l0
+
+
+def test_num_cycles_exhaustion(controller):
+    _one_round(controller, ["w1", "w2"])
+    _one_round(controller, ["w3", "w4"])
+    # num_cycles=2 reached: no open cycle remains
+    from pygrid_tpu.utils.exceptions import CycleNotFoundError
+
+    with pytest.raises(CycleNotFoundError):
+        controller.cycle_manager.last(1)
+
+
+def test_checkpoint_history_retrievable(controller):
+    _one_round(controller, ["w1", "w2"])
+    first = controller.model_manager.load(model_id=1, number=1)
+    latest = controller.model_manager.load(model_id=1, alias="latest")
+    assert first.number == 1 and latest.number == 2
+
+
+# --- federated JWT auth -----------------------------------------------------
+
+
+def test_auth_unauthenticated_allowed():
+    assert fed_auth.verify_token(None, {})["status"] == "success"
+
+
+def test_auth_hs256_roundtrip():
+    cfg = {"authentication": {"secret": "topsecret"}}
+    token = fed_auth.jwt_encode({"sub": "w1"}, secret="topsecret")
+    assert fed_auth.verify_token(token, cfg)["payload"]["sub"] == "w1"
+    with pytest.raises(AuthorizationError):
+        fed_auth.verify_token(token[:-3] + "xyz", cfg)
+    with pytest.raises(AuthorizationError):
+        fed_auth.verify_token(None, cfg)
+
+
+def test_auth_rs256_roundtrip():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    priv = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.PEM, serialization.PublicFormat.SubjectPublicKeyInfo
+    )
+    cfg = {"authentication": {"pub_key": pub.decode()}}
+    token = fed_auth.jwt_encode({"sub": "w2"}, private_key_pem=priv)
+    assert fed_auth.verify_token(token, cfg)["payload"]["sub"] == "w2"
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    bad = fed_auth.jwt_encode(
+        {"sub": "w2"},
+        private_key_pem=other.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+    )
+    with pytest.raises(AuthorizationError):
+        fed_auth.verify_token(bad, cfg)
+
+
+def test_auth_expired_token():
+    import time
+
+    cfg = {"authentication": {"secret": "s"}}
+    token = fed_auth.jwt_encode({"sub": "w", "exp": time.time() - 10}, secret="s")
+    with pytest.raises(AuthorizationError):
+        fed_auth.verify_token(token, cfg)

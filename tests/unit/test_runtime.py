@@ -134,6 +134,55 @@ def test_plan_methods_not_remotely_invokable(alice):
         )
 
 
+def test_private_plan_not_runnable_by_others(alice):
+    plan = Plan(name="secret-model", fn=lambda x: x * 2.0)
+    plan.build(np.zeros((2,), np.float32))
+    alice.recv_obj_msg(
+        M.ObjectMessage(obj=plan, id=888, allowed_users=["ana"]), user="ana"
+    )
+    with pytest.raises(GetNotPermittedError):
+        alice.recv_obj_msg(
+            M.RunPlanMessage(plan_id=888, args=[np.ones(2, np.float32)]), user="eve"
+        )
+    # ana's run result inherits ana-only permissions
+    resp = alice.recv_obj_msg(
+        M.RunPlanMessage(plan_id=888, args=[np.ones(2, np.float32)]), user="ana"
+    )
+    with pytest.raises(GetNotPermittedError):
+        PointerTensor(alice, resp.id_at_location, owner_user="eve").get()
+
+
+def test_delete_permission_gated(alice):
+    priv = send(np.array([1.0]), alice, allowed_users=("ana",), user="ana")
+    with pytest.raises(GetNotPermittedError):
+        alice.recv_obj_msg(
+            M.ForceObjectDeleteMessage(obj_id=priv.id_at_location), user="eve"
+        )
+    assert priv.id_at_location in alice.store
+    alice.recv_obj_msg(
+        M.ForceObjectDeleteMessage(obj_id=priv.id_at_location), user="ana"
+    )
+    assert priv.id_at_location not in alice.store
+
+
+def test_id_reuse_rejected(alice):
+    alice.recv_obj_msg(M.ObjectMessage(obj=np.ones(2), id=321))
+    with pytest.raises(PyGridError):
+        alice.recv_obj_msg(M.ObjectMessage(obj=np.zeros(2), id=321))
+    np.testing.assert_array_equal(
+        np.asarray(alice.store.get_obj(321).value), np.ones(2)
+    )
+
+
+def test_crypto_provider_streams_differ():
+    from pygrid_tpu.smpc import CryptoProvider
+    from pygrid_tpu.smpc import ring as R
+
+    t1 = CryptoProvider()._make_triple("mul", (4,), (4,), 2)
+    t2 = CryptoProvider()._make_triple("mul", (4,), (4,), 2)
+    assert not np.array_equal(np.asarray(t1[0].lo), np.asarray(t2[0].lo))
+
+
 def test_shape_mismatch_returns_error_frame(alice):
     """Routine execution errors serialize as typed frames, never crash."""
     p1 = send(np.ones((2, 3)), alice)

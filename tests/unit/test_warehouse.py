@@ -1,0 +1,75 @@
+"""Warehouse/sqlite repository — mirrors reference apps/node/tests/database/
+(insert/query/modify/delete per schema, in-memory DB per test)."""
+
+import datetime as dt
+
+import pytest
+
+from pygrid_tpu.federated import schemas as S
+from pygrid_tpu.storage import Database, Warehouse
+
+
+@pytest.fixture()
+def db():
+    d = Database(":memory:")
+    yield d
+    d.close()
+
+
+def test_autoincrement_and_query(db):
+    wh = Warehouse(S.FLProcess, db)
+    p1 = wh.register(name="mnist", version="1.0")
+    p2 = wh.register(name="mnist", version="2.0")
+    assert p1.id == 1 and p2.id == 2
+    assert wh.count() == 2
+    assert wh.first(name="mnist", version="2.0").id == p2.id
+    assert wh.contains(name="mnist") and not wh.contains(name="cifar")
+
+
+def test_string_pk_worker(db):
+    wh = Warehouse(S.Worker, db)
+    w = wh.register(id="worker-abc", ping=3.5, avg_download=100.0, avg_upload=50.0)
+    got = wh.first(id="worker-abc")
+    assert got.ping == 3.5 and got.avg_upload == 50.0
+
+
+def test_dict_blob_roundtrip(db):
+    wh = Warehouse(S.Config, db)
+    cfg = {"batch_size": 64, "lr": 0.005, "auth": {"secret": "s"}, "lst": [1, 2]}
+    wh.register(config=cfg, is_server_config=True, fl_process_id=1)
+    got = wh.first(fl_process_id=1)
+    assert got.config == cfg and got.is_server_config is True
+
+
+def test_datetime_and_bytes(db):
+    wh = Warehouse(S.WorkerCycle, db)
+    now = dt.datetime(2026, 7, 29, 12, 0, 0)
+    wh.register(
+        cycle_id=1, worker_id="w", request_key="k", started_at=now, diff=b"\x01\x02"
+    )
+    got = wh.first(worker_id="w")
+    assert got.started_at == now and got.diff == b"\x01\x02"
+    assert got.is_completed is False
+
+
+def test_modify_and_delete(db):
+    wh = Warehouse(S.Cycle, db)
+    c = wh.register(fl_process_id=1, sequence=1, version="1.0")
+    wh.modify({"id": c.id}, {"is_completed": True})
+    assert wh.first(id=c.id).is_completed is True
+    wh.delete(id=c.id)
+    assert wh.count() == 0
+
+
+def test_last_ordering(db):
+    wh = Warehouse(S.ModelCheckPoint, db)
+    for n in (1, 2, 3):
+        wh.register(value=bytes([n]), model_id=7, number=n, alias="")
+    assert wh.last(model_id=7).number == 3
+    assert wh.first(model_id=7).number == 1
+
+
+def test_null_filter(db):
+    wh = Warehouse(S.Cycle, db)
+    wh.register(fl_process_id=1, sequence=1, version="", end=None)
+    assert wh.count(end=None) == 1
