@@ -224,14 +224,18 @@ class CycleManager:
         ``server_config["iterative_plan"]`` (reference :261-271)."""
         plan = self.plan_manager.deserialize_plan(avg_plan_rec.value_xla)
         if server_config.get("iterative_plan"):
-            # avg = plan(avg, diff, i) running-mean signature
-            avg = [jnp.asarray(p) for p in diff_params[0]]
+            # running-mean signature avg = plan(*avg, *diff, i) — index LAST,
+            # matching the reference's avg_plan(diff_avg, diff, tensor([i+1]))
+            # (cycle_manager.py:269)
+            avg = [np.asarray(p) for p in diff_params[0]]
             for i, diff in enumerate(diff_params[1:], start=1):
                 out = plan(
-                    np.float32(i), *[np.asarray(a) for a in avg],
+                    *[np.asarray(a) for a in avg],
                     *[np.asarray(d) for d in diff],
+                    np.float32(i + 1),
                 )
-                avg = list(out) if isinstance(out, (list, tuple)) else [out]
+                out = list(out) if isinstance(out, (list, tuple)) else [out]
+                avg = [np.asarray(a) for a in out]
             return avg
         flat: list = []
         for diff in diff_params:

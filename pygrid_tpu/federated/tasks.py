@@ -15,7 +15,11 @@ from typing import Any, Callable
 
 logger = logging.getLogger(__name__)
 
-_in_flight: set[str] = set()
+# key -> "running" | "rerun" (a trigger that arrives while running must not
+# be dropped: the running pass may have read state from before the trigger's
+# write — e.g. the final diff landing during a readiness check — so the task
+# re-runs once after it finishes)
+_state: dict[str, str] = {}
 _lock = threading.Lock()
 _sync = False
 
@@ -26,21 +30,26 @@ def set_sync(sync: bool) -> None:
 
 
 def run_task_once(key: str, fn: Callable, *args: Any) -> None:
-    """Run ``fn(*args)`` unless a task with ``key`` is already in flight."""
+    """Run ``fn(*args)``; coalesce concurrent triggers to one pending rerun."""
     with _lock:
-        if key in _in_flight:
-            logger.debug("task %s already in flight — skipped", key)
+        if key in _state:
+            _state[key] = "rerun"
+            logger.debug("task %s in flight — rerun queued", key)
             return
-        _in_flight.add(key)
+        _state[key] = "running"
 
     def _run() -> None:
-        try:
-            fn(*args)
-        except Exception:  # noqa: BLE001 — background boundary, must not die silently
-            logger.exception("background task %s failed", key)
-        finally:
+        while True:
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — background boundary
+                logger.exception("background task %s failed", key)
             with _lock:
-                _in_flight.discard(key)
+                if _state.get(key) == "rerun":
+                    _state[key] = "running"
+                    continue
+                _state.pop(key, None)
+                return
 
     if _sync:
         _run()

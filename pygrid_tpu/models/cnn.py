@@ -62,10 +62,8 @@ def loss_and_acc(params, X, y):
 
 
 def training_step(X, y, lr, *params):
-    def loss_fn(p):
-        return loss_and_acc(p, X, y)[0]
-
-    loss, grads = jax.value_and_grad(loss_fn)(list(params))
+    (loss, acc), grads = jax.value_and_grad(loss_and_acc, has_aux=True)(
+        list(params), X, y
+    )
     new_params = [p - lr * g for p, g in zip(params, grads)]
-    _, acc = loss_and_acc(list(params), X, y)
     return (loss, acc, *new_params)

@@ -48,11 +48,8 @@ def loss_and_acc(params: Sequence[jax.Array], X: jax.Array, y: jax.Array):
 
 def training_step(X, y, lr, *params):
     """One SGD step; traceable into a Plan (reference plan signature)."""
-
-    def loss_fn(p):
-        return loss_and_acc(p, X, y)[0]
-
-    loss, grads = jax.value_and_grad(loss_fn)(list(params))
+    (loss, acc), grads = jax.value_and_grad(loss_and_acc, has_aux=True)(
+        list(params), X, y
+    )
     new_params = [p - lr * g for p, g in zip(params, grads)]
-    _, acc = loss_and_acc(list(params), X, y)
     return (loss, acc, *new_params)

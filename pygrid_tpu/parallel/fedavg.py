@@ -16,7 +16,6 @@ reduce loop — cycle_manager.py:275-290):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Sequence
 
 import jax

@@ -18,7 +18,11 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
 from pygrid_tpu.plans.placeholder import fresh_id
-from pygrid_tpu.utils.exceptions import GetNotPermittedError, ObjectNotFoundError
+from pygrid_tpu.utils.exceptions import (
+    GetNotPermittedError,
+    ObjectNotFoundError,
+    PyGridError,
+)
 
 
 @dataclass
@@ -56,7 +60,12 @@ class ObjectStore:
         description: str = "",
         allowed_users: Iterable[str] | None = None,
         garbage_collect_data: bool = True,
+        overwrite: bool = False,
     ) -> StoredObject:
+        if id is not None and int(id) in self._objects and not overwrite:
+            # client-chosen ids (ObjectMessage.id, command return_id) must not
+            # silently replace existing objects — poisoning vector
+            raise PyGridError(f"object id {id} already in use")
         obj = StoredObject(
             value=value,
             id=int(id) if id is not None else fresh_id(),

@@ -145,10 +145,9 @@ class VirtualWorker:
     # --- handlers -----------------------------------------------------------
 
     def _handle_object(self, msg: M.ObjectMessage, user: str | None):
-        if msg.id is not None and msg.id in self.store:
-            # client-chosen ids must not silently replace existing objects
-            # (poisoning another user's stored data)
-            raise E.PyGridError(f"object id {msg.id} already in use")
+        # id-reuse rejection lives in ObjectStore.set_obj, covering every
+        # path that stores at a client-chosen id (object push, command
+        # return_id, plan return_id)
         obj = self.store.set_obj(
             value=msg.obj,
             id=msg.id,

@@ -221,6 +221,81 @@ def test_checkpoint_history_retrievable(controller):
     assert first.number == 1 and latest.number == 2
 
 
+def test_iterative_averaging_plan():
+    """Hosted running-mean averaging plan: avg = plan(*avg, *diff, i) with the
+    index LAST (reference cycle_manager.py:269)."""
+    db = Database(":memory:")
+    ctl = FLController(db)
+
+    def running_mean(avg_w, avg_b, diff_w, diff_b, i):
+        new_w = (avg_w * (i - 1) + diff_w) / i
+        new_b = (avg_b * (i - 1) + diff_b) / i
+        return new_w, new_b
+
+    avg_plan = Plan(name="avg", fn=running_mean)
+    avg_plan.build(
+        np.zeros((10, 4), np.float32), np.zeros(4, np.float32),
+        np.zeros((10, 4), np.float32), np.zeros(4, np.float32),
+        np.float32(1.0),
+    )
+    ctl.create_process(
+        model_blob=serialize_model_params(_model_params()),
+        client_plans={"training_plan": _training_plan()},
+        server_averaging_plan=avg_plan,
+        name="mnist", version="1.0",
+        client_config={},
+        server_config={**SERVER_CONFIG, "iterative_plan": True, "num_cycles": 1},
+    )
+    p0 = unserialize_model_params(
+        ctl.model_manager.load(model_id=1, alias="latest").value
+    )
+    diffs = []
+    for wid in ("w1", "w2"):
+        w = ctl.worker_manager.create(wid)
+        w.avg_upload = w.avg_download = 100.0
+        ctl.worker_manager.update(w)
+        resp = ctl.assign("mnist", "1.0", ctl.worker_manager.get(id=wid))
+        d = [np.full((10, 4), 0.5 if wid == "w1" else 1.5, np.float32),
+             np.full(4, 0.1 if wid == "w1" else 0.3, np.float32)]
+        diffs.append(d)
+        ctl.submit_diff(wid, resp[CYCLE.KEY], serialize_model_params(d))
+    p1 = unserialize_model_params(
+        ctl.model_manager.load(model_id=1, alias="latest").value
+    )
+    # avg of the two diffs: w -> 1.0, b -> 0.2
+    np.testing.assert_allclose(p0[0] - p1[0], np.full((10, 4), 1.0), atol=1e-5)
+    np.testing.assert_allclose(p0[1] - p1[1], np.full(4, 0.2), atol=1e-5)
+
+
+def test_run_task_once_rerun_coalescing():
+    """A trigger arriving mid-run must re-run the task once, not be dropped."""
+    import threading as th
+    import time
+
+    tasks.set_sync(False)
+    try:
+        runs, gate = [], th.Event()
+
+        def task():
+            runs.append(1)
+            if len(runs) == 1:
+                gate.wait(5)
+
+        tasks.run_task_once("k", task)      # starts, blocks on gate
+        time.sleep(0.05)
+        tasks.run_task_once("k", task)      # arrives mid-run -> queued
+        tasks.run_task_once("k", task)      # coalesced with the queued one
+        gate.set()
+        for _ in range(100):
+            with tasks._lock:
+                if "k" not in tasks._state:
+                    break
+            time.sleep(0.02)
+        assert len(runs) == 2  # initial + exactly one rerun
+    finally:
+        tasks.set_sync(True)
+
+
 # --- federated JWT auth -----------------------------------------------------
 
 

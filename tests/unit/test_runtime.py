@@ -172,6 +172,14 @@ def test_id_reuse_rejected(alice):
     np.testing.assert_array_equal(
         np.asarray(alice.store.get_obj(321).value), np.ones(2)
     )
+    # the command-result path must not overwrite either
+    with pytest.raises(PyGridError):
+        alice.recv_obj_msg(
+            M.TensorCommandMessage(op="add", args=[1.0, 1.0], return_id=321)
+        )
+    np.testing.assert_array_equal(
+        np.asarray(alice.store.get_obj(321).value), np.ones(2)
+    )
 
 
 def test_crypto_provider_streams_differ():
