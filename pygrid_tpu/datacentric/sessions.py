@@ -1,0 +1,100 @@
+"""Per-user worker sessions for the data-centric plane.
+
+Parity surface: reference ``data_centric/auth/user_session.py`` — a
+flask_login ``UserMixin`` owning **one VirtualWorker per user** (``:29-34``)
+plus a queue of pending tensor-access requests (``:44-51``), and
+``session_repository.py:14-16`` seeding a default ``admin/admin`` account.
+Here sessions are framework-agnostic objects the aiohttp node app keys by an
+auth token; the per-user worker is the same
+:class:`~pygrid_tpu.runtime.worker.VirtualWorker` the rest of the runtime
+uses, federated with the node's singleton worker so pointers resolve.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+from typing import Any
+
+from pygrid_tpu.runtime.worker import VirtualWorker
+from pygrid_tpu.utils.exceptions import InvalidCredentialsError
+
+
+def _hash_password(password: str, salt: bytes | None = None) -> bytes:
+    salt = salt if salt is not None else secrets.token_bytes(16)
+    digest = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 100_000)
+    return salt + digest
+
+
+def _check_password(password: str, stored: bytes) -> bool:
+    salt, digest = stored[:16], stored[16:]
+    candidate = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 100_000)
+    return hmac.compare_digest(candidate, digest)
+
+
+class UserSession:
+    """One authenticated data-scientist session = one VirtualWorker
+    (reference user_session.py:29-34) + a tensor-request queue (:44-51)."""
+
+    def __init__(self, username: str, password_hash: bytes) -> None:
+        self.username = username
+        self._password_hash = password_hash
+        self.authenticated = False
+        self._worker: VirtualWorker | None = None
+        #: requests saved when a .get() hits GetNotPermittedError — the owner
+        #: reviews and releases them (reference's tensor_requests list)
+        self.tensor_requests: list[dict[str, Any]] = []
+
+    @property
+    def worker(self) -> VirtualWorker:
+        if self._worker is None:
+            self._worker = VirtualWorker(id=self.username)
+        return self._worker
+
+    def check_credentials(self, password: str) -> bool:
+        return _check_password(password, self._password_hash)
+
+    def save_tensor_request(self, request: dict[str, Any]) -> None:
+        self.tensor_requests.append(request)
+
+
+class SessionsRepository:
+    """username → UserSession registry with a default admin/admin account
+    (reference session_repository.py:14-16)."""
+
+    def __init__(self, seed_admin: bool = True) -> None:
+        self._sessions: dict[str, UserSession] = {}
+        #: token → session for WS/HTTP auth continuity
+        self._tokens: dict[str, UserSession] = {}
+        if seed_admin:
+            self.register("admin", "admin")
+
+    def register(self, username: str, password: str) -> UserSession:
+        if username in self._sessions:
+            raise InvalidCredentialsError(f"user {username} already exists")
+        session = UserSession(username, _hash_password(password))
+        self._sessions[username] = session
+        return session
+
+    def get_session(self, username: str) -> UserSession | None:
+        return self._sessions.get(username)
+
+    def login(self, username: str, password: str) -> tuple[UserSession, str]:
+        session = self._sessions.get(username)
+        if session is None or not session.check_credentials(password):
+            raise InvalidCredentialsError()
+        session.authenticated = True
+        token = secrets.token_hex(16)
+        self._tokens[token] = session
+        return session, token
+
+    def by_token(self, token: str | None) -> UserSession | None:
+        if token is None:
+            return None
+        return self._tokens.get(token)
+
+    def logout(self, token: str) -> None:
+        session = self._tokens.pop(token, None)
+        if session is not None and session not in self._tokens.values():
+            session.authenticated = False

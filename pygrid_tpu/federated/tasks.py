@@ -15,11 +15,11 @@ from typing import Any, Callable
 
 logger = logging.getLogger(__name__)
 
-# key -> "running" | "rerun" (a trigger that arrives while running must not
-# be dropped: the running pass may have read state from before the trigger's
-# write — e.g. the final diff landing during a readiness check — so the task
-# re-runs once after it finishes)
-_state: dict[str, str] = {}
+# key -> {"status": "running" | "rerun", "call": (fn, args)}. A trigger that
+# arrives while running must not be dropped: the running pass may have read
+# state from before the trigger's write — e.g. the final diff landing during
+# a readiness check — so the task re-runs once, with the latest call's args.
+_state: dict[str, dict[str, Any]] = {}
 _lock = threading.Lock()
 _sync = False
 
@@ -33,20 +33,22 @@ def run_task_once(key: str, fn: Callable, *args: Any) -> None:
     """Run ``fn(*args)``; coalesce concurrent triggers to one pending rerun."""
     with _lock:
         if key in _state:
-            _state[key] = "rerun"
+            _state[key] = {"status": "rerun", "call": (fn, args)}
             logger.debug("task %s in flight — rerun queued", key)
             return
-        _state[key] = "running"
+        _state[key] = {"status": "running", "call": (fn, args)}
 
     def _run() -> None:
         while True:
+            with _lock:
+                run_fn, run_args = _state[key]["call"]
             try:
-                fn(*args)
+                run_fn(*run_args)
             except Exception:  # noqa: BLE001 — background boundary
                 logger.exception("background task %s failed", key)
             with _lock:
-                if _state.get(key) == "rerun":
-                    _state[key] = "running"
+                if _state.get(key, {}).get("status") == "rerun":
+                    _state[key]["status"] = "running"
                     continue
                 _state.pop(key, None)
                 return

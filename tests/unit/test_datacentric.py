@@ -1,0 +1,177 @@
+"""Data-centric persistence plane: KV store, object write-through/recovery,
+model storage/controller, user sessions.
+
+Mirrors the reference's persistence behavior (SURVEY.md §2.1 rows 'Tensor
+persistence (Redis)', 'Model storage/cache/controller', 'DC session auth'):
+tensors survive a worker restart via write-through + recover_objects; hosted
+models keep their permission flags; admin/admin is seeded.
+"""
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.datacentric import (
+    MemoryKV,
+    ModelController,
+    SessionsRepository,
+    SqliteKV,
+    recover_objects,
+    set_persistent_mode,
+)
+from pygrid_tpu.plans.plan import func2plan
+from pygrid_tpu.runtime.worker import VirtualWorker
+from pygrid_tpu.serde import serialize
+from pygrid_tpu.utils.exceptions import (
+    InvalidCredentialsError,
+    ModelNotFoundError,
+    PyGridError,
+)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def kv(request, tmp_path):
+    if request.param == "memory":
+        return MemoryKV()
+    return SqliteKV(str(tmp_path / "kv.db"))
+
+
+class TestKVStore:
+    def test_hash_ops(self, kv):
+        kv.hset("h", "a", b"1")
+        kv.hset("h", "b", b"2")
+        assert kv.hget("h", "a") == b"1"
+        assert kv.hgetall("h") == {"a": b"1", "b": b"2"}
+        assert kv.hexists("h", "b") and not kv.hexists("h", "zz")
+        assert kv.hdel("h", "a") == 1
+        assert kv.hget("h", "a") is None
+        kv.delete("h")
+        assert kv.hgetall("h") == {}
+
+    def test_overwrite(self, kv):
+        kv.hset("h", "k", b"old")
+        kv.hset("h", "k", b"new")
+        assert kv.hget("h", "k") == b"new"
+
+
+class TestObjectPersistence:
+    def test_write_through_and_recover(self, kv):
+        w = VirtualWorker(id="alice")
+        set_persistent_mode(w, kv)
+        obj = w.store.set_obj(
+            np.arange(6.0).reshape(2, 3), tags={"#x", "#mnist"},
+            description="train data",
+        )
+        # simulate restart: fresh worker, same id, same KV
+        w2 = VirtualWorker(id="alice")
+        set_persistent_mode(w2, kv)
+        assert recover_objects(w2, kv) == 1
+        got = w2.store.get_obj(obj.id)
+        np.testing.assert_array_equal(np.asarray(got.value), obj.value)
+        assert got.tags == {"#x", "#mnist"}
+        assert got.description == "train data"
+
+    def test_delete_propagates(self, kv):
+        w = VirtualWorker(id="bob")
+        set_persistent_mode(w, kv)
+        obj = w.store.set_obj(np.ones(3))
+        w.store.rm_obj(obj.id)
+        w2 = VirtualWorker(id="bob")
+        assert recover_objects(w2, kv) == 0
+
+    def test_permissions_survive_restart(self, kv):
+        w = VirtualWorker(id="carol")
+        set_persistent_mode(w, kv)
+        obj = w.store.set_obj(np.ones(2), allowed_users={"dan"})
+        w2 = VirtualWorker(id="carol")
+        recover_objects(w2, kv)
+        assert w2.store.get_obj(obj.id).allowed_users == {"dan"}
+
+    def test_recover_idempotent(self, kv):
+        w = VirtualWorker(id="erin")
+        set_persistent_mode(w, kv)
+        w.store.set_obj(np.ones(2))
+        assert recover_objects(w, kv) == 0  # already resident
+
+
+class TestModelStorage:
+    def _plan_blob(self):
+        @func2plan(args_shape=[(1, 4)])
+        def model(x):
+            return x * 2.0
+
+        return serialize(model)
+
+    def test_save_get_flags(self, kv):
+        mc = ModelController(kv)
+        mc.save("node1", self._plan_blob(), "mnist",
+                allow_remote_inference=True, mpc=False)
+        hosted = mc.get("node1", "mnist")
+        assert hosted.allow_remote_inference and not hosted.allow_download
+        assert "mnist" in mc.models("node1")
+
+    def test_duplicate_id_rejected(self, kv):
+        mc = ModelController(kv)
+        mc.save("node1", self._plan_blob(), "m1")
+        with pytest.raises(PyGridError):
+            mc.save("node1", self._plan_blob(), "m1")
+
+    def test_delete(self, kv):
+        mc = ModelController(kv)
+        mc.save("node1", self._plan_blob(), "m1")
+        mc.delete("node1", "m1")
+        with pytest.raises(ModelNotFoundError):
+            mc.get("node1", "m1")
+        assert "m1" not in mc.models("node1")
+
+    def test_survives_controller_restart(self, tmp_path):
+        path = str(tmp_path / "models.db")
+        ModelController(SqliteKV(path)).save(
+            "node1", self._plan_blob(), "persisted", allow_download=True
+        )
+        mc2 = ModelController(SqliteKV(path))
+        hosted = mc2.get("node1", "persisted")
+        assert hosted.allow_download
+        assert "persisted" in mc2.models("node1")
+
+    def test_inference_via_stored_plan(self, kv):
+        mc = ModelController(kv)
+        mc.save("node1", self._plan_blob(), "double",
+                allow_remote_inference=True)
+        hosted = mc.get("node1", "double")
+        out = hosted.model(np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(out), 2 * np.ones((1, 4)))
+
+
+class TestSessions:
+    def test_default_admin(self):
+        repo = SessionsRepository()
+        session, token = repo.login("admin", "admin")
+        assert session.authenticated
+        assert repo.by_token(token) is session
+
+    def test_bad_credentials(self):
+        repo = SessionsRepository()
+        with pytest.raises(InvalidCredentialsError):
+            repo.login("admin", "wrong")
+        with pytest.raises(InvalidCredentialsError):
+            repo.login("ghost", "x")
+
+    def test_per_user_worker(self):
+        repo = SessionsRepository()
+        repo.register("ds1", "pw")
+        s1, _ = repo.login("ds1", "pw")
+        s2, _ = repo.login("admin", "admin")
+        assert s1.worker.id == "ds1" and s2.worker.id == "admin"
+        assert s1.worker is not s2.worker
+
+    def test_logout(self):
+        repo = SessionsRepository()
+        _, token = repo.login("admin", "admin")
+        repo.logout(token)
+        assert repo.by_token(token) is None
+
+    def test_tensor_request_queue(self):
+        repo = SessionsRepository()
+        s, _ = repo.login("admin", "admin")
+        s.save_tensor_request({"object_id": 42, "reason": "research"})
+        assert s.tensor_requests[0]["object_id"] == 42
