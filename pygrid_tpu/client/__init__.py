@@ -1,0 +1,25 @@
+"""Client SDK — the grid's user-facing surface.
+
+Parity surface: the syft 0.2.9 grid clients the reference consumes
+(SURVEY.md §2.4 'Grid clients'): ``ModelCentricFLClient``
+(.host_federated_training), ``DataCentricFLClient`` (tensor send/get, model
+host/inference, node mesh), ``FLClient``/``FLJob`` (the edge-worker training
+loop with accepted/rejected/error events), and ``PublicGridNetwork``
+(grid-wide search). All speak the same JSON-WS/HTTP protocol the Node and
+Network serve.
+"""
+
+from pygrid_tpu.client.base import GridWSClient
+from pygrid_tpu.client.data_centric import DataCentricFLClient
+from pygrid_tpu.client.fl_client import FLClient, FLJob
+from pygrid_tpu.client.model_centric import ModelCentricFLClient
+from pygrid_tpu.client.network import PublicGridNetwork
+
+__all__ = [
+    "GridWSClient",
+    "DataCentricFLClient",
+    "FLClient",
+    "FLJob",
+    "ModelCentricFLClient",
+    "PublicGridNetwork",
+]

@@ -1,0 +1,86 @@
+"""Synchronous WebSocket grid client.
+
+The transport under every SDK client: JSON request/response with request_id
+correlation plus raw binary frames (the two frame kinds the Node's
+``route_requests`` handles — reference ``events/__init__.py:61-107``).
+Built on ``websockets.sync`` (no asyncio in user code, mirroring the
+reference's blocking syft clients).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from typing import Any
+
+from websockets.sync.client import connect
+
+from pygrid_tpu.utils.codes import MSG_FIELD
+
+
+class GridWSClient:
+    def __init__(self, address: str, timeout: float = 30.0) -> None:
+        self.address = address.rstrip("/")
+        ws_url = self.address
+        for scheme, ws_scheme in (("https", "wss"), ("http", "ws")):
+            if ws_url.startswith(scheme + "://"):
+                ws_url = ws_scheme + "://" + ws_url[len(scheme) + 3:]
+                break
+        self.ws_url = ws_url
+        self.timeout = timeout
+        self._ws = None
+        self._lock = threading.Lock()
+
+    # ── connection ──────────────────────────────────────────────────────────
+
+    def connect(self) -> "GridWSClient":
+        if self._ws is None:
+            self._ws = connect(
+                self.ws_url, open_timeout=self.timeout, max_size=2**28
+            )
+        return self
+
+    def close(self) -> None:
+        if self._ws is not None:
+            self._ws.close()
+            self._ws = None
+
+    def __enter__(self) -> "GridWSClient":
+        return self.connect()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ── request/response ────────────────────────────────────────────────────
+
+    def send_json(self, msg_type: str, data: Any = None, **top_level) -> dict:
+        """One JSON round-trip; request_id correlates the response."""
+        self.connect()
+        request_id = uuid.uuid4().hex
+        message: dict[str, Any] = {
+            MSG_FIELD.TYPE: msg_type,
+            MSG_FIELD.REQUEST_ID: request_id,
+        }
+        if data is not None:
+            message[MSG_FIELD.DATA] = data
+        message.update(top_level)
+        with self._lock:
+            self._ws.send(json.dumps(message))
+            while True:
+                raw = self._ws.recv(timeout=self.timeout)
+                if isinstance(raw, bytes):
+                    continue  # stray binary frame: not ours
+                response = json.loads(raw)
+                if response.get(MSG_FIELD.REQUEST_ID) in (None, request_id):
+                    return response
+
+    def send_binary(self, blob: bytes) -> bytes:
+        """One binary round-trip (syft wire messages)."""
+        self.connect()
+        with self._lock:
+            self._ws.send(blob)
+            while True:
+                raw = self._ws.recv(timeout=self.timeout)
+                if isinstance(raw, bytes):
+                    return raw

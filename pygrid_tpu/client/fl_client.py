@@ -1,0 +1,223 @@
+"""FLClient / FLJob — the edge-worker training client.
+
+Parity surface: the PySyft ``FLClient``/``FLJob`` pair the reference's
+execute-plan notebook drives (``examples/model-centric/02-ExecutePlan.ipynb``
+cells 7-15, SURVEY.md §3.3): authenticate (JWT) → optional speed test →
+cycle-request → on *accepted* download model checkpoint + plans → local
+training → ``job.report(diff)``; *rejected* carries a retry timeout. Events
+surface as ACCEPTED / REJECTED / ERROR listener callbacks.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Callable
+
+import requests
+
+from pygrid_tpu.client.base import GridWSClient
+from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
+from pygrid_tpu.serde import deserialize
+from pygrid_tpu.utils.codes import CYCLE, MODEL_CENTRIC_FL_EVENTS, MSG_FIELD
+from pygrid_tpu.utils.exceptions import PyGridError
+
+
+class FLJob:
+    EVENT_ACCEPTED = "accepted"
+    EVENT_REJECTED = "rejected"
+    EVENT_ERROR = "error"
+
+    def __init__(
+        self,
+        client: "FLClient",
+        model_name: str,
+        model_version: str | None = None,
+    ) -> None:
+        self.client = client
+        self.model_name = model_name
+        self.model_version = model_version
+        self._listeners: dict[str, list[Callable]] = {
+            self.EVENT_ACCEPTED: [],
+            self.EVENT_REJECTED: [],
+            self.EVENT_ERROR: [],
+        }
+        # filled on accept
+        self.worker_id: str | None = None
+        self.request_key: str | None = None
+        self.model_params: list | None = None
+        self.plans: dict[str, Any] = {}
+        self.client_config: dict = {}
+        self.timeout: int | None = None  # retry window on reject
+
+    def add_listener(self, event: str, callback: Callable) -> None:
+        self._listeners[event].append(callback)
+
+    def _emit(self, event: str, *args: Any) -> None:
+        for cb in self._listeners[event]:
+            cb(self, *args)
+
+    # ── the cycle flow (SURVEY §3.3 steps 1-6) ─────────────────────────────
+
+    def start(self, ping: float = 1.0, download: float = 1000.0,
+              upload: float = 1000.0) -> None:
+        try:
+            auth = self.client.authenticate(
+                self.model_name, self.model_version
+            )
+            if auth.get("error"):
+                raise PyGridError(auth["error"])
+            self.worker_id = auth[MSG_FIELD.WORKER_ID]
+            if auth.get(MSG_FIELD.REQUIRES_SPEED_TEST):
+                ping, download, upload = self.client.speed_test(self.worker_id)
+            cycle = self.client.cycle_request(
+                self.worker_id, self.model_name, self.model_version,
+                ping=ping, download=download, upload=upload,
+            )
+            if cycle.get(CYCLE.STATUS) == CYCLE.ACCEPTED:
+                self.request_key = cycle[CYCLE.KEY]
+                self.client_config = cycle.get(CYCLE.CLIENT_CONFIG) or {}
+                model_id = cycle[MSG_FIELD.MODEL_ID]
+                self.model_params = self.client.get_model(
+                    self.worker_id, self.request_key, model_id
+                )
+                self.plans = {
+                    name: self.client.get_plan(
+                        self.worker_id, self.request_key, plan_id
+                    )
+                    for name, plan_id in (cycle.get(CYCLE.PLANS) or {}).items()
+                }
+                self._emit(self.EVENT_ACCEPTED)
+            else:
+                self.timeout = cycle.get(CYCLE.TIMEOUT)
+                self._emit(self.EVENT_REJECTED, self.timeout)
+        except Exception as err:  # noqa: BLE001 — event boundary
+            self._emit(self.EVENT_ERROR, err)
+
+    def report(self, diff_params: list) -> dict:
+        """Upload the weight diff (reference fl_events.py report:237-271)."""
+        blob = serialize_model_params(list(diff_params))
+        return self.client.report(self.worker_id, self.request_key, blob)
+
+
+class FLClient:
+    def __init__(
+        self,
+        url: str,
+        auth_token: str | None = None,
+        verbose: bool = False,
+        timeout: float = 60.0,
+    ) -> None:
+        self.ws = GridWSClient(url, timeout=timeout)
+        self.address = self.ws.address
+        self.auth_token = auth_token
+        self.verbose = verbose
+
+    def new_job(self, model_name: str, model_version: str | None = None) -> FLJob:
+        return FLJob(self, model_name, model_version)
+
+    # ── protocol steps ─────────────────────────────────────────────────────
+
+    def authenticate(self, model_name: str, model_version: str | None) -> dict:
+        response = self.ws.send_json(
+            MODEL_CENTRIC_FL_EVENTS.AUTHENTICATE,
+            data={
+                "auth_token": self.auth_token,
+                "model_name": model_name,
+                "model_version": model_version,
+            },
+        )
+        return response.get(MSG_FIELD.DATA, response)
+
+    def speed_test(
+        self, worker_id: str, sample_bytes: int = 1024 * 1024
+    ) -> tuple[float, float, float]:
+        """Measure ping/download/upload against /model-centric/speed-test
+        (reference routes.py:62-99; 64MB default sample trimmed via ?size=)."""
+        url = f"{self.address}/model-centric/speed-test"
+        params = {"worker_id": worker_id, "random": "1"}
+        t0 = time.monotonic()
+        requests.get(url, params={**params, "is_ping": "1"}, timeout=30)
+        ping_ms = (time.monotonic() - t0) * 1000
+        t0 = time.monotonic()
+        resp = requests.get(
+            url, params={**params, "size": str(sample_bytes)}, timeout=60
+        )
+        dl = len(resp.content) / max(time.monotonic() - t0, 1e-9) / 125_000
+        t0 = time.monotonic()
+        requests.post(url, params=params, data=b"x" * sample_bytes, timeout=60)
+        ul = sample_bytes / max(time.monotonic() - t0, 1e-9) / 125_000
+        return ping_ms, dl, ul  # ms, Mbps, Mbps
+
+    def cycle_request(
+        self,
+        worker_id: str,
+        model_name: str,
+        model_version: str | None,
+        ping: float,
+        download: float,
+        upload: float,
+    ) -> dict:
+        response = self.ws.send_json(
+            MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST,
+            data={
+                MSG_FIELD.WORKER_ID: worker_id,
+                MSG_FIELD.MODEL: model_name,
+                CYCLE.VERSION: model_version,
+                CYCLE.PING: ping,
+                CYCLE.DOWNLOAD: download,
+                CYCLE.UPLOAD: upload,
+            },
+        )
+        return response.get(MSG_FIELD.DATA, response)
+
+    def get_model(
+        self, worker_id: str, request_key: str, model_id: int
+    ) -> list:
+        resp = requests.get(
+            f"{self.address}/model-centric/get-model",
+            params={
+                "worker_id": worker_id,
+                "request_key": request_key,
+                "model_id": str(model_id),
+            },
+            timeout=60,
+        )
+        if resp.status_code != 200:
+            raise PyGridError(resp.text)
+        return unserialize_model_params(resp.content)
+
+    def get_plan(
+        self,
+        worker_id: str,
+        request_key: str,
+        plan_id: int,
+        receive_operations_as: str = "xla",
+    ) -> Any:
+        resp = requests.get(
+            f"{self.address}/model-centric/get-plan",
+            params={
+                "worker_id": worker_id,
+                "request_key": request_key,
+                "plan_id": str(plan_id),
+                "receive_operations_as": receive_operations_as,
+            },
+            timeout=60,
+        )
+        if resp.status_code != 200:
+            raise PyGridError(resp.text)
+        return deserialize(resp.content)
+
+    def report(self, worker_id: str, request_key: str, diff_blob: bytes) -> dict:
+        response = self.ws.send_json(
+            MODEL_CENTRIC_FL_EVENTS.REPORT,
+            data={
+                MSG_FIELD.WORKER_ID: worker_id,
+                CYCLE.KEY: request_key,
+                CYCLE.DIFF: base64.b64encode(diff_blob).decode(),
+            },
+        )
+        return response.get(MSG_FIELD.DATA, response)
+
+    def close(self) -> None:
+        self.ws.close()

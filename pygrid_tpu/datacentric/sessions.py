@@ -12,25 +12,21 @@ uses, federated with the node's singleton worker so pointers resolve.
 
 from __future__ import annotations
 
-import hashlib
-import hmac
 import secrets
 from typing import Any
 
 from pygrid_tpu.runtime.worker import VirtualWorker
 from pygrid_tpu.utils.exceptions import InvalidCredentialsError
+from pygrid_tpu.utils.passwords import hash_password, verify_password
 
 
-def _hash_password(password: str, salt: bytes | None = None) -> bytes:
-    salt = salt if salt is not None else secrets.token_bytes(16)
-    digest = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 100_000)
+def _hash_password(password: str) -> bytes:
+    salt, digest = hash_password(password)
     return salt + digest
 
 
 def _check_password(password: str, stored: bytes) -> bool:
-    salt, digest = stored[:16], stored[16:]
-    candidate = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 100_000)
-    return hmac.compare_digest(candidate, digest)
+    return verify_password(password, stored[:16], stored[16:])
 
 
 class UserSession:
@@ -79,6 +75,9 @@ class SessionsRepository:
 
     def get_session(self, username: str) -> UserSession | None:
         return self._sessions.get(username)
+
+    def all_sessions(self) -> list[UserSession]:
+        return list(self._sessions.values())
 
     def login(self, username: str, password: str) -> tuple[UserSession, str]:
         session = self._sessions.get(username)

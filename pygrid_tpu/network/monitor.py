@@ -1,0 +1,118 @@
+"""Node health monitoring.
+
+Parity surface: reference ``apps/network/src/app/workers/worker.py`` — a
+per-node proxy tracking ping / status (online < 5s ping < busy; no contact →
+offline), cached hosted models/datasets/cpu/mem, refreshed by a 15 s
+heartbeat loop (``worker.py:67-86``; constants ``codes.py:51-56``). The
+reference pushes a WS ``monitor`` message and waits for ``monitor-answer``;
+here the loop *also* falls back to HTTP polling of the node's public
+endpoints, so socketless (HTTP-joined) nodes are monitored identically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+PING_THRESHOLD_MS = 5000.0  # reference WORKER_PROPERTIES.PING_THRESHOLD
+ONLINE, BUSY, OFFLINE = "online", "busy", "offline"
+
+
+class NodeProxy:
+    def __init__(self, node_id: str, address: str, socket: Any = None) -> None:
+        self.id = node_id
+        self.address = address
+        self.socket = socket
+        self.ping: float | None = None  # ms
+        self.last_seen: float | None = None
+        self.connected_nodes: list = []
+        self.hosted_models: list = []
+        self.hosted_datasets: list = []
+        self.cpu_percent: float | None = None
+        self.mem_usage: float | None = None
+        self._monitor_sent_at: float | None = None
+
+    @property
+    def status(self) -> str:
+        if self.ping is None:
+            return OFFLINE
+        if self.ping < PING_THRESHOLD_MS:
+            return ONLINE
+        return BUSY
+
+    def mark_offline(self) -> None:
+        self.ping = None
+        self.socket = None
+
+    def monitor_sent(self) -> None:
+        self._monitor_sent_at = time.monotonic()
+
+    def update_from_answer(self, message: dict) -> None:
+        """WS monitor-answer payload (reference worker.py:76-86)."""
+        if self._monitor_sent_at is not None:
+            self.ping = (time.monotonic() - self._monitor_sent_at) * 1000
+            self._monitor_sent_at = None  # a duplicate answer must not
+            # recompute ping from this consumed timestamp
+        self.last_seen = time.time()
+        self.connected_nodes = message.get("nodes") or []
+        self.hosted_models = message.get("models") or []
+        self.hosted_datasets = message.get("datasets") or []
+        self.cpu_percent = message.get("cpu")
+        self.mem_usage = message.get("mem")
+
+
+async def poll_node(proxy: NodeProxy) -> None:
+    """HTTP fallback heartbeat: status + models + dataset tags."""
+    import aiohttp
+
+    t0 = time.monotonic()
+    try:
+        timeout = aiohttp.ClientTimeout(total=5)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(
+                proxy.address + "/data-centric/status/"
+            ) as resp:
+                if resp.status != 200:
+                    proxy.mark_offline()
+                    return
+                await resp.json()
+            proxy.ping = (time.monotonic() - t0) * 1000
+            proxy.last_seen = time.time()
+            async with session.get(
+                proxy.address + "/data-centric/models/"
+            ) as resp:
+                proxy.hosted_models = (await resp.json()).get("models", [])
+            async with session.get(
+                proxy.address + "/data-centric/dataset-tags"
+            ) as resp:
+                proxy.hosted_datasets = await resp.json()
+    except Exception:  # noqa: BLE001 — unreachable node is a data point
+        proxy.mark_offline()
+
+
+async def monitor_loop(ctx) -> None:
+    """15 s heartbeat across all registered nodes (reference worker.py:67-74).
+    Socket-joined nodes get a WS `monitor` push; the rest are HTTP-polled."""
+    import json
+
+    while True:
+        try:
+            for node_id, address in ctx.manager.connected_nodes().items():
+                proxy = ctx.proxy(node_id, address)
+                if proxy.socket is not None:
+                    try:
+                        proxy.monitor_sent()
+                        await proxy.socket.send_str(
+                            json.dumps({"type": "monitor"})
+                        )
+                    except Exception:  # noqa: BLE001
+                        proxy.mark_offline()
+                else:
+                    await poll_node(proxy)
+        except Exception:  # noqa: BLE001 — keep the loop alive
+            logger.exception("monitor sweep failed")
+        await asyncio.sleep(ctx.monitor_interval)

@@ -1,0 +1,276 @@
+"""Network HTTP routes.
+
+Parity surface: reference ``apps/network/src/app/routes/network.py`` —
+/join (:22), /connected-nodes (:55), /delete-node (:67),
+/choose-encrypted-model-host (:98, n_replica × SMPC_HOST_CHUNK sampling),
+/choose-model-host (:134), /search-encrypted-model (:157, fan-out),
+/search-model (:201), /search-available-models (:229),
+/search-available-tags (:247), /search (:266) — plus /models and /datasets
+aggregates (``routes/models.py``, ``routes/dataset.py``) and the users CRUD
+twin. Fan-outs run concurrently (asyncio.gather) instead of the reference's
+sequential requests loop; per-node connection errors are swallowed the same
+way (reference network.py:173-175).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+from typing import Any
+
+import aiohttp
+from aiohttp import web
+
+from pygrid_tpu.network import SMPC_HOST_CHUNK, NetworkContext
+
+logger = logging.getLogger(__name__)
+
+INVALID_JSON_FORMAT_MESSAGE = "Invalid JSON format."
+
+
+def _ctx(request: web.Request) -> NetworkContext:
+    return request.app["network"]
+
+
+async def _fanout(
+    nodes: dict[str, str],
+    path: str,
+    method: str = "get",
+    body: dict | None = None,
+) -> dict[str, Any]:
+    """Concurrently hit `path` on every node; unreachable nodes drop out."""
+    timeout = aiohttp.ClientTimeout(total=10)
+
+    async def one(node_id: str, address: str):
+        try:
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                if method == "get":
+                    async with session.get(address + path) as resp:
+                        return node_id, await resp.json()
+                async with session.post(address + path, json=body) as resp:
+                    return node_id, await resp.json()
+        except Exception:  # noqa: BLE001 — reference swallows ConnectionError
+            return node_id, None
+
+    results = await asyncio.gather(
+        *(one(nid, addr) for nid, addr in nodes.items())
+    )
+    return {nid: payload for nid, payload in results if payload is not None}
+
+
+# ── registry ────────────────────────────────────────────────────────────────
+
+
+async def join(request: web.Request) -> web.Response:
+    try:
+        data = json.loads(await request.text())
+        ok = _ctx(request).manager.register_new_node(
+            data["node-id"], data["node-address"]
+        )
+        if ok:
+            _ctx(request).proxy(data["node-id"], data["node-address"])
+            return web.json_response({"message": "Successfully Connected!"})
+        return web.json_response(
+            {"message": "This ID has already been registered"}, status=409
+        )
+    except (ValueError, KeyError):
+        return web.json_response(
+            {"message": INVALID_JSON_FORMAT_MESSAGE}, status=400
+        )
+
+
+async def connected_nodes(request: web.Request) -> web.Response:
+    nodes = _ctx(request).manager.connected_nodes()
+    return web.json_response({"grid-nodes": list(nodes.keys())})
+
+
+async def delete_node(request: web.Request) -> web.Response:
+    try:
+        data = json.loads(await request.text())
+        ok = _ctx(request).manager.delete_node(
+            data["node-id"], data["node-address"]
+        )
+        if ok:
+            _ctx(request).proxies.pop(data["node-id"], None)
+            return web.json_response({"message": "Successfully Deleted!"})
+        return web.json_response(
+            {"message": "This ID was not found in connected nodes"}, status=409
+        )
+    except (ValueError, KeyError):
+        return web.json_response(
+            {"message": INVALID_JSON_FORMAT_MESSAGE}, status=400
+        )
+
+
+# ── host selection ──────────────────────────────────────────────────────────
+
+
+async def choose_encrypted_model_host(request: web.Request) -> web.Response:
+    """Sample n_replica × SMPC_HOST_CHUNK nodes to hold shares
+    (reference network.py:98-131)."""
+    ctx = _ctx(request)
+    nodes = ctx.manager.connected_nodes()
+    try:
+        hosts = random.sample(
+            list(nodes.keys()), ctx.n_replica * SMPC_HOST_CHUNK
+        )
+    except ValueError:  # not enough nodes
+        return web.json_response([], status=400)
+    return web.json_response([(h, nodes[h]) for h in hosts])
+
+
+async def _get_model_hosting_nodes(
+    ctx: NetworkContext, model_id: str
+) -> list:
+    nodes = ctx.manager.connected_nodes()
+    results = await _fanout(nodes, "/data-centric/models/")
+    return [
+        (nid, nodes[nid])
+        for nid, payload in results.items()
+        if model_id in (payload.get("models") or [])
+    ]
+
+
+async def choose_model_host(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    nodes = ctx.manager.connected_nodes()
+    model_id = request.query.get("model_id")
+    hosts_info = None
+    if model_id:
+        hosts_info = await _get_model_hosting_nodes(ctx, model_id)
+    if not hosts_info:
+        try:
+            hosts = random.sample(list(nodes.keys()), ctx.n_replica or 1)
+        except ValueError:
+            return web.json_response([], status=400)
+        hosts_info = [(h, nodes[h]) for h in hosts]
+    return web.json_response(hosts_info)
+
+
+# ── search fan-outs ─────────────────────────────────────────────────────────
+
+
+async def search_encrypted_model(request: web.Request) -> web.Response:
+    """(reference network.py:157-198) → {node: {address, nodes: {workers,
+    crypto_provider}}} for every node hosting shares of the model."""
+    ctx = _ctx(request)
+    try:
+        body = json.loads(await request.text())
+    except ValueError:
+        return web.json_response(
+            {"message": INVALID_JSON_FORMAT_MESSAGE}, status=400
+        )
+    nodes = ctx.manager.connected_nodes()
+    results = await _fanout(
+        nodes, "/data-centric/search-encrypted-models", "post", body
+    )
+    match_nodes = {
+        nid: {"address": nodes[nid], "nodes": payload}
+        for nid, payload in results.items()
+        if {"workers", "crypto_provider"} <= set(payload.keys())
+    }
+    return web.json_response({"match-nodes": match_nodes})
+
+
+async def search_model(request: web.Request) -> web.Response:
+    try:
+        body = json.loads(await request.text())
+        match = await _get_model_hosting_nodes(_ctx(request), body["model_id"])
+        return web.json_response({"match-nodes": match})
+    except (ValueError, KeyError):
+        return web.json_response(
+            {"message": INVALID_JSON_FORMAT_MESSAGE}, status=400
+        )
+
+
+async def search_available_models(request: web.Request) -> web.Response:
+    nodes = _ctx(request).manager.connected_nodes()
+    results = await _fanout(nodes, "/data-centric/models/")
+    models: set[str] = set()
+    for payload in results.values():
+        models.update(payload.get("models") or [])
+    return web.json_response({"models": sorted(models)})
+
+
+async def search_available_tags(request: web.Request) -> web.Response:
+    nodes = _ctx(request).manager.connected_nodes()
+    results = await _fanout(nodes, "/data-centric/dataset-tags")
+    tags: set[str] = set()
+    for payload in results.values():
+        if isinstance(payload, list):
+            tags.update(payload)
+    return web.json_response({"tags": sorted(tags)})
+
+
+async def search(request: web.Request) -> web.Response:
+    """(reference network.py:266-306) dataset tag search → [(id, address)]."""
+    ctx = _ctx(request)
+    try:
+        body = json.loads(await request.text())
+        query = body["query"]
+    except (ValueError, KeyError):
+        return web.json_response(
+            {"message": INVALID_JSON_FORMAT_MESSAGE}, status=400
+        )
+    nodes = ctx.manager.connected_nodes()
+    results = await _fanout(
+        nodes, "/data-centric/search", "post", {"query": query}
+    )
+    matches = [
+        (nid, nodes[nid])
+        for nid, payload in results.items()
+        if payload.get("content")
+    ]
+    return web.json_response({"match-nodes": matches})
+
+
+# ── monitor aggregates (reference routes/models.py, routes/dataset.py) ──────
+
+
+async def models(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    return web.json_response(
+        {"models": [p.hosted_models for p in ctx.proxies.values()]}
+    )
+
+
+async def datasets(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    return web.json_response(
+        {"datasets": [p.hosted_datasets for p in ctx.proxies.values()]}
+    )
+
+
+async def nodes_status(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    return web.json_response(
+        {
+            nid: {
+                "address": p.address,
+                "status": p.status,
+                "ping_ms": p.ping,
+                "models": p.hosted_models,
+                "datasets": p.hosted_datasets,
+            }
+            for nid, p in ctx.proxies.items()
+        }
+    )
+
+
+def register(app: web.Application) -> None:
+    r = app.router
+    r.add_post("/join", join)
+    r.add_get("/connected-nodes", connected_nodes)
+    r.add_delete("/delete-node", delete_node)
+    r.add_get("/choose-encrypted-model-host", choose_encrypted_model_host)
+    r.add_get("/choose-model-host", choose_model_host)
+    r.add_post("/search-encrypted-model", search_encrypted_model)
+    r.add_post("/search-model", search_model)
+    r.add_get("/search-available-models", search_available_models)
+    r.add_get("/search-available-tags", search_available_tags)
+    r.add_post("/search", search)
+    r.add_get("/models", models)
+    r.add_get("/datasets", datasets)
+    r.add_get("/nodes-status", nodes_status)

@@ -1,0 +1,107 @@
+"""Node app — the coordination-plane server.
+
+Parity surface: reference ``apps/node/src/app/__init__.py`` (create_app:131,
+seed_db:79, blueprints /, /model-centric, /data-centric + WS at
+``:173-178``) and ``apps/node/src/__main__.py`` (CLI + network join + server).
+The reference serves gevent WSGI + Flask-Sockets; here it is one asyncio
+aiohttp application carrying HTTP routes and the WebSocket endpoint.
+
+``NodeContext`` is the app-wide singleton the reference scatters across
+module globals (local_worker, model_controller, session repo, FLController):
+one object, explicitly threaded through handlers.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from pygrid_tpu.datacentric import (
+    KVStore,
+    MemoryKV,
+    ModelController,
+    SessionsRepository,
+    SqliteKV,
+    set_persistent_mode,
+)
+from pygrid_tpu.federated.controller import FLController
+from pygrid_tpu.runtime.worker import VirtualWorker
+from pygrid_tpu.storage.warehouse import Database
+from pygrid_tpu.users import UserManager
+
+__version__ = "0.1.0"
+
+
+class NodeContext:
+    """Everything one Node owns (reference main/__init__.py:8-17 globals +
+    app factory wiring)."""
+
+    def __init__(
+        self,
+        node_id: str,
+        database_url: str = ":memory:",
+        kv: KVStore | None = None,
+        kv_path: str | None = None,
+        secret_key: str | None = None,
+        network_url: str | None = None,
+        num_replicas: int | None = None,
+    ) -> None:
+        self.id = node_id
+        self.address: str | None = None
+        self.network_url = network_url
+        self.num_replicas = num_replicas
+        self.db = Database(database_url)
+        self.kv: KVStore = (
+            kv
+            if kv is not None
+            else (SqliteKV(kv_path) if kv_path else MemoryKV())
+        )
+        self.secret_key = secret_key or secrets.token_hex(16)
+
+        # the Node's singleton party (reference local_worker)
+        self.local_worker = VirtualWorker(id=node_id)
+        set_persistent_mode(self.local_worker, self.kv)
+
+        self.fl = FLController(self.db)
+        self.models = ModelController(self.kv)
+        self.sessions = SessionsRepository()
+        self.users = UserManager(self.db, secret_key=self.secret_key)
+
+    def all_stores(self):
+        """The node's singleton store plus every live session worker's store —
+        the scan surface for public discovery routes (/dataset-tags, /search),
+        mirroring the reference's local_worker._objects scan
+        (routes/data_centric/routes.py:171-189,253-273)."""
+        stores = [self.local_worker.store]
+        for session in self.sessions.all_sessions():
+            if session._worker is not None:
+                stores.append(session._worker.store)
+        return stores
+
+
+def create_app(
+    node_id: str,
+    database_url: str = ":memory:",
+    kv_path: str | None = None,
+    secret_key: str | None = None,
+    network_url: str | None = None,
+    num_replicas: int | None = None,
+):
+    """Build the aiohttp application (reference create_app, __init__.py:131)."""
+    from aiohttp import web
+
+    from pygrid_tpu.node import routes as R
+    from pygrid_tpu.node.ws import ws_handler
+
+    ctx = NodeContext(
+        node_id,
+        database_url=database_url,
+        kv_path=kv_path,
+        secret_key=secret_key,
+        network_url=network_url,
+        num_replicas=num_replicas,
+    )
+    app = web.Application(client_max_size=256 * 1024 * 1024)
+    app["node"] = ctx
+    app.router.add_get("/", ws_handler)  # WS upgrade or landing JSON
+    R.register(app)
+    return app

@@ -1,0 +1,543 @@
+"""WS event handlers + dispatch table for the Node.
+
+Parity surface: reference ``apps/node/src/app/main/events/`` — the routes
+table (``events/__init__.py:23-57``), ``route_requests`` (JSON dispatch by
+``type``; **binary frames → forward_binary_message**, ``:61-107``), the
+model-centric FL events (``model_centric/fl_events.py``), the data-centric
+syft/model/control events (``data_centric/*.py``), and the user/role/group WS
+twins. Handlers are transport-agnostic: they take (ctx, message, conn) and
+return a dict; the aiohttp WS endpoint (pygrid_tpu.node.ws) does the framing.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import logging
+import uuid
+from dataclasses import asdict
+from typing import Any, Callable
+
+from pygrid_tpu.datacentric.object_storage import recover_objects
+from pygrid_tpu.federated.auth import verify_token
+from pygrid_tpu.node import NodeContext, __version__
+from pygrid_tpu.node.sockets import SocketHandler
+from pygrid_tpu.serde import deserialize, serialize
+from pygrid_tpu.utils import exceptions as E
+from pygrid_tpu.utils.codes import (
+    CONTROL_EVENTS,
+    CYCLE,
+    GROUP_EVENTS,
+    MODEL_CENTRIC_FL_EVENTS,
+    MSG_FIELD,
+    REQUEST_MSG,
+    ROLE_EVENTS,
+    USER_EVENTS,
+)
+
+logger = logging.getLogger(__name__)
+
+SUCCESS = "success"
+ERROR = "error"
+
+
+class Connection:
+    """Per-WebSocket state: the data-centric login session and the FL
+    worker id bound to this socket."""
+
+    def __init__(self, ctx: NodeContext, socket: Any = None) -> None:
+        self.ctx = ctx
+        self.socket = socket
+        self.session = None  # UserSession after `authentication`
+        self.worker_id: str | None = None
+
+    @property
+    def worker(self):
+        if self.session is None:
+            raise E.AuthorizationError("authentication required")
+        return self.session.worker
+
+
+# ── model-centric FL events (reference fl_events.py) ─────────────────────────
+
+
+def _unhex(value: str | None) -> bytes | None:
+    if value is None:
+        return None
+    return binascii.unhexlify(value.encode())
+
+
+def host_federated_training(
+    ctx: NodeContext, message: dict, conn: Connection
+) -> dict:
+    """(reference fl_events.py:27-75) deserialize hex model/plans/protocols/
+    avg-plan and create the FLProcess + first cycle."""
+    data = message.get(MSG_FIELD.DATA) or {}
+    response: dict[str, Any] = {}
+    try:
+        model_blob = _unhex(data.get(MSG_FIELD.MODEL))
+        client_plans = {
+            k: _unhex(v) for k, v in (data.get(CYCLE.PLANS) or {}).items()
+        }
+        client_protocols = {
+            k: _unhex(v) for k, v in (data.get(CYCLE.PROTOCOLS) or {}).items()
+        }
+        avg_plan = _unhex(data.get(CYCLE.AVG_PLAN))
+        client_config = data.get(CYCLE.CLIENT_CONFIG) or {}
+        server_config = data.get(CYCLE.SERVER_CONFIG) or {}
+        ctx.fl.create_process(
+            model_blob=model_blob,
+            client_plans=client_plans,
+            name=client_config.get("name", ""),
+            version=client_config.get("version", ""),
+            client_config=client_config,
+            server_config=server_config,
+            server_averaging_plan=avg_plan,
+            client_protocols=client_protocols,
+        )
+        response[CYCLE.STATUS] = SUCCESS
+    except Exception as err:  # noqa: BLE001 — protocol boundary
+        logger.exception("host-training failed")
+        response[ERROR] = str(err)
+    return {
+        MSG_FIELD.TYPE: MODEL_CENTRIC_FL_EVENTS.HOST_FL_TRAINING,
+        MSG_FIELD.DATA: response,
+    }
+
+
+def requires_speed_test(ctx: NodeContext, name: str, version: str | None) -> bool:
+    """(reference fl_events.py:112-128) true when the process sets bandwidth
+    minimums."""
+    filters = {"name": name}
+    if version:
+        filters["version"] = version
+    process = ctx.fl.process_manager.first(**filters)
+    server_config = ctx.fl.process_manager.get_configs(
+        fl_process_id=process.id, is_server_config=True
+    )
+    return (
+        server_config.get("minimum_upload_speed") is not None
+        or server_config.get("minimum_download_speed") is not None
+    )
+
+
+def assign_worker_id(ctx: NodeContext, conn: Connection, handler: SocketHandler):
+    """(reference fl_events.py:77-109) uuid4 worker id + socket binding."""
+    worker_id = str(uuid.uuid4())
+    handler.new_connection(worker_id, conn.socket)
+    conn.worker_id = worker_id
+    ctx.fl.worker_manager.create(worker_id)
+    return worker_id
+
+
+def authenticate(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """(reference fl_events.py:131-166) JWT verification → worker id."""
+    data = message.get(MSG_FIELD.DATA) or {}
+    response: dict[str, Any] = {}
+    try:
+        name = data.get("model_name")
+        version = data.get("model_version")
+        filters = {"name": name}
+        if version:
+            filters["version"] = version
+        process = ctx.fl.process_manager.first(**filters)
+        server_config = ctx.fl.process_manager.get_configs(
+            fl_process_id=process.id, is_server_config=True
+        )
+        verify_token(data.get("auth_token"), server_config)
+        worker_id = assign_worker_id(ctx, conn, _handler_of(ctx))
+        response[CYCLE.STATUS] = SUCCESS
+        response[MSG_FIELD.WORKER_ID] = worker_id
+        response[MSG_FIELD.REQUIRES_SPEED_TEST] = requires_speed_test(
+            ctx, name, version
+        )
+    except Exception as err:  # noqa: BLE001 — protocol boundary
+        response[ERROR] = str(err)
+    return {
+        MSG_FIELD.TYPE: MODEL_CENTRIC_FL_EVENTS.AUTHENTICATE,
+        MSG_FIELD.DATA: response,
+    }
+
+
+def cycle_request(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """(reference fl_events.py:169-234) speed-field validation → assign."""
+    data = message.get(MSG_FIELD.DATA) or {}
+    response: dict[str, Any] = {}
+    try:
+        worker_id = data.get(MSG_FIELD.WORKER_ID)
+        name = data.get(MSG_FIELD.MODEL)
+        version = data.get(CYCLE.VERSION)
+        worker = ctx.fl.worker_manager.get(id=worker_id)
+        fields_map = {
+            CYCLE.PING: "ping",
+            CYCLE.DOWNLOAD: "avg_download",
+            CYCLE.UPLOAD: "avg_upload",
+        }
+        speed_required = requires_speed_test(ctx, name, version)
+        for request_field, db_field in fields_map.items():
+            if request_field in data:
+                value = data.get(request_field)
+                if not isinstance(value, (float, int)) or isinstance(
+                    value, bool
+                ) or value < 0:
+                    raise E.PyGridError(
+                        f"'{request_field}' needs to be a positive number"
+                    )
+                setattr(worker, db_field, float(value))
+            elif speed_required:
+                raise E.PyGridError(f"'{request_field}' is required")
+        ctx.fl.worker_manager.update(worker)
+        response = ctx.fl.assign(name, version, worker)
+    except E.CycleNotFoundError:
+        response[CYCLE.STATUS] = CYCLE.REJECTED
+    except E.MaxCycleLimitExceededError as err:
+        response[CYCLE.STATUS] = CYCLE.REJECTED
+        response[MSG_FIELD.MODEL] = getattr(err, "name", None)
+    except Exception as err:  # noqa: BLE001 — protocol boundary
+        response[CYCLE.STATUS] = CYCLE.REJECTED
+        response[ERROR] = str(err)
+    return {
+        MSG_FIELD.TYPE: MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST,
+        MSG_FIELD.DATA: response,
+    }
+
+
+def report(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """(reference fl_events.py:237-271) base64 diff → submit."""
+    data = message.get(MSG_FIELD.DATA) or {}
+    response: dict[str, Any] = {}
+    try:
+        diff = base64.b64decode((data.get(CYCLE.DIFF) or "").encode())
+        ctx.fl.submit_diff(
+            data.get(MSG_FIELD.WORKER_ID), data.get(CYCLE.KEY), diff
+        )
+        response[CYCLE.STATUS] = SUCCESS
+    except Exception as err:  # noqa: BLE001 — protocol boundary
+        response[ERROR] = str(err)
+    return {
+        MSG_FIELD.TYPE: MODEL_CENTRIC_FL_EVENTS.REPORT,
+        MSG_FIELD.DATA: response,
+    }
+
+
+# ── data-centric control events (reference control_events.py) ────────────────
+
+
+def get_node_infos(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    return {
+        MSG_FIELD.NODE_ID: ctx.local_worker.id,
+        MSG_FIELD.SYFT_VERSION: __version__,
+    }
+
+
+def authentication(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """(reference control_events.py:28-42) credentials → per-user session."""
+    try:
+        session, token = ctx.sessions.login(
+            message.get(MSG_FIELD.USERNAME_FIELD),
+            message.get(MSG_FIELD.PASSWORD_FIELD),
+        )
+    except E.PyGridError:
+        return {ERROR: "Invalid username/password!"}
+    conn.session = session
+    # federate the user's worker with the node's singleton so pointers to
+    # either store resolve over this connection
+    ctx.local_worker.add_worker(session.worker)
+    # grid peers dialed before this login become reachable from this session
+    for peer_id, peer in ctx.local_worker._known_workers.items():
+        session.worker._known_workers.setdefault(peer_id, peer)
+    return {SUCCESS: "True", MSG_FIELD.NODE_ID: session.worker.id, "token": token}
+
+
+def connect_grid_nodes(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """(reference control_events.py:44-54) node-to-node mesh: dial the peer
+    and register it as a known worker."""
+    peer_id = message.get("id")
+    if peer_id not in ctx.local_worker._known_workers:
+        from pygrid_tpu.client.data_centric import DataCentricFLClient
+
+        peer = DataCentricFLClient(message.get("address"), id=peer_id)
+        ctx.local_worker._known_workers[peer_id] = peer
+        # session workers route through the same peer (tensors live there)
+        for session in ctx.sessions.all_sessions():
+            if session._worker is not None:
+                session._worker._known_workers.setdefault(peer_id, peer)
+    return {"status": "Succesfully connected."}
+
+
+def socket_ping(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    return {MSG_FIELD.ALIVE: "True"}
+
+
+# ── data-centric syft events (reference syft_events.py) ──────────────────────
+
+
+def forward_binary_message(
+    ctx: NodeContext, message: bytes | bytearray, conn: Connection
+) -> bytes:
+    """(reference syft_events.py:18-45) binary wire msg → per-user worker."""
+    if conn.session is None:
+        return serialize(
+            {"error_type": "AuthorizationError", "message": "login required"}
+        )
+    worker = conn.worker
+    if len(worker.store) == 0:
+        recover_objects(worker, ctx.kv)
+    return worker._recv_msg(bytes(message), user=conn.session.username)
+
+
+def syft_command(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """JSON variant of the binary path (reference syft_events.py:49-59)."""
+    msg = deserialize(binascii.unhexlify(message[MSG_FIELD.DATA]))
+    response = conn.worker.recv_obj_msg(msg, user=conn.session.username)
+    return {MSG_FIELD.DATA: binascii.hexlify(serialize(response)).decode()}
+
+
+# ── data-centric model events (reference model_events.py) ────────────────────
+
+
+def _authenticated(conn: Connection) -> None:
+    if conn.session is None:
+        raise E.AuthorizationError("authentication required")
+
+
+def host_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    _authenticated(conn)
+    try:
+        serialized = message[MSG_FIELD.MODEL]
+        if isinstance(serialized, str):
+            serialized = base64.b64decode(serialized)
+        return ctx.models.save(
+            ctx.local_worker.id,
+            bytes(serialized),
+            message[MSG_FIELD.MODEL_ID],
+            allow_download=str(message.get(MSG_FIELD.ALLOW_DOWNLOAD)) == "True",
+            allow_remote_inference=str(
+                message.get(MSG_FIELD.ALLOW_REMOTE_INFERENCE)
+            )
+            == "True",
+            mpc=str(message.get(MSG_FIELD.MPC)) == "True",
+        )
+    except E.PyGridError as err:
+        return {SUCCESS: False, ERROR: str(err)}
+
+
+def delete_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    _authenticated(conn)
+    try:
+        return ctx.models.delete(ctx.local_worker.id, message[MSG_FIELD.MODEL_ID])
+    except E.PyGridError as err:
+        return {SUCCESS: False, ERROR: str(err)}
+
+
+def get_models(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    _authenticated(conn)
+    return {MSG_FIELD.MODELS: ctx.models.models(ctx.local_worker.id)}
+
+
+def run_inference(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """(reference model_events.py:77-129) run a hosted model on submitted
+    data; predictions return as a plain list."""
+    _authenticated(conn)
+    import numpy as np
+
+    try:
+        if len(ctx.local_worker.store) == 0:
+            recover_objects(ctx.local_worker, ctx.kv)
+        hosted = ctx.models.get(ctx.local_worker.id, message[MSG_FIELD.MODEL_ID])
+        if not hosted.allow_remote_inference:
+            return {
+                SUCCESS: False,
+                "not_allowed": True,
+                ERROR: "You're not allowed to run inferences on this model.",
+            }
+        blob = message[MSG_FIELD.DATA]
+        if isinstance(blob, str):
+            blob = base64.b64decode(blob)
+        data = deserialize(bytes(blob))
+        output = hosted.model(data)
+        if isinstance(output, (tuple, list)):
+            output = output[0]
+        return {SUCCESS: True, "prediction": np.asarray(output).tolist()}
+    except E.PyGridError as err:
+        return {SUCCESS: False, ERROR: str(err)}
+
+
+# ── user / role / group WS twins (reference {user,role,group}_related.py) ────
+
+
+def _serializable(obj: Any) -> Any:
+    if hasattr(obj, "__dataclass_fields__"):
+        d = asdict(obj)
+        d.pop("hashed_password", None)
+        d.pop("salt", None)
+        d.pop("private_key", None)
+        return d
+    return obj
+
+
+def _user_op(fn: Callable) -> Callable:
+    """Wrap a UserManager call: resolve the token, format the response."""
+
+    def wrapper(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+        data = message.get(MSG_FIELD.DATA) or message
+        try:
+            current = ctx.users.resolve_token(data.get("token"))
+            result = fn(ctx, current, data)
+            if isinstance(result, list):
+                result = [_serializable(r) for r in result]
+            else:
+                result = _serializable(result)
+            return {CYCLE.STATUS: SUCCESS, MSG_FIELD.DATA: result}
+        except E.PyGridError as err:
+            return {ERROR: str(err)}
+
+    return wrapper
+
+
+def signup_user(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    data = message.get(MSG_FIELD.DATA) or message
+    try:
+        user = ctx.users.signup(
+            data.get("email"),
+            data.get("password"),
+            role=data.get("role"),
+            private_key=data.get("private-key"),
+        )
+        return {CYCLE.STATUS: SUCCESS, "user": _serializable(user)}
+    except E.PyGridError as err:
+        return {ERROR: str(err)}
+
+
+def login_user(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    data = message.get(MSG_FIELD.DATA) or message
+    try:
+        token = ctx.users.login(
+            data.get("email"),
+            data.get("password"),
+            private_key=data.get("private-key"),
+        )
+        return {CYCLE.STATUS: SUCCESS, "token": token}
+    except E.PyGridError as err:
+        return {ERROR: str(err)}
+
+
+_USER_HANDLERS = {
+    USER_EVENTS.SIGNUP_USER: signup_user,
+    USER_EVENTS.LOGIN_USER: login_user,
+    USER_EVENTS.GET_ALL_USERS: _user_op(
+        lambda ctx, cur, d: ctx.users.get_all_users(cur)
+    ),
+    USER_EVENTS.GET_SPECIFIC_USER: _user_op(
+        lambda ctx, cur, d: ctx.users.get_user(cur, int(d["id"]))
+    ),
+    USER_EVENTS.SEARCH_USERS: _user_op(
+        lambda ctx, cur, d: ctx.users.search_users(
+            cur, **{k: v for k, v in d.items() if k in ("email", "role")}
+        )
+    ),
+    USER_EVENTS.PUT_EMAIL: _user_op(
+        lambda ctx, cur, d: ctx.users.change_email(cur, int(d["id"]), d["email"])
+    ),
+    USER_EVENTS.PUT_PASSWORD: _user_op(
+        lambda ctx, cur, d: ctx.users.change_password(
+            cur, int(d["id"]), d["password"]
+        )
+    ),
+    USER_EVENTS.PUT_ROLE: _user_op(
+        lambda ctx, cur, d: ctx.users.change_role(cur, int(d["id"]), d["role"])
+    ),
+    USER_EVENTS.PUT_GROUPS: _user_op(
+        lambda ctx, cur, d: ctx.users.change_groups(
+            cur, int(d["id"]), d["groups"]
+        )
+    ),
+    USER_EVENTS.DELETE_USER: _user_op(
+        lambda ctx, cur, d: ctx.users.delete_user(cur, int(d["id"]))
+    ),
+    ROLE_EVENTS.CREATE_ROLE: _user_op(
+        lambda ctx, cur, d: ctx.users.create_role(
+            cur, **{k: v for k, v in d.items() if k != "token"}
+        )
+    ),
+    ROLE_EVENTS.GET_ROLE: _user_op(
+        lambda ctx, cur, d: ctx.users.get_role(cur, int(d["id"]))
+    ),
+    ROLE_EVENTS.GET_ALL_ROLES: _user_op(
+        lambda ctx, cur, d: ctx.users.get_all_roles(cur)
+    ),
+    ROLE_EVENTS.PUT_ROLE: _user_op(
+        lambda ctx, cur, d: ctx.users.put_role(
+            cur, int(d["id"]), **{k: v for k, v in d.items() if k not in ("token", "id")}
+        )
+    ),
+    ROLE_EVENTS.DELETE_ROLE: _user_op(
+        lambda ctx, cur, d: ctx.users.delete_role(cur, int(d["id"]))
+    ),
+    GROUP_EVENTS.CREATE_GROUP: _user_op(
+        lambda ctx, cur, d: ctx.users.create_group(cur, d["name"])
+    ),
+    GROUP_EVENTS.GET_GROUP: _user_op(
+        lambda ctx, cur, d: ctx.users.get_group(cur, int(d["id"]))
+    ),
+    GROUP_EVENTS.GET_ALL_GROUPS: _user_op(
+        lambda ctx, cur, d: ctx.users.get_all_groups(cur)
+    ),
+    GROUP_EVENTS.PUT_GROUP: _user_op(
+        lambda ctx, cur, d: ctx.users.put_group(
+            cur, int(d["id"]), **{k: v for k, v in d.items() if k not in ("token", "id")}
+        )
+    ),
+    GROUP_EVENTS.DELETE_GROUP: _user_op(
+        lambda ctx, cur, d: ctx.users.delete_group(cur, int(d["id"]))
+    ),
+}
+
+# ── dispatch ─────────────────────────────────────────────────────────────────
+
+ROUTES: dict[str, Callable[[NodeContext, dict, Connection], dict]] = {
+    CONTROL_EVENTS.SOCKET_PING: socket_ping,
+    MODEL_CENTRIC_FL_EVENTS.HOST_FL_TRAINING: host_federated_training,
+    MODEL_CENTRIC_FL_EVENTS.AUTHENTICATE: authenticate,
+    MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST: cycle_request,
+    MODEL_CENTRIC_FL_EVENTS.REPORT: report,
+    REQUEST_MSG.GET_ID: get_node_infos,
+    REQUEST_MSG.CONNECT_NODE: connect_grid_nodes,
+    REQUEST_MSG.HOST_MODEL: host_model,
+    REQUEST_MSG.RUN_INFERENCE: run_inference,
+    REQUEST_MSG.DELETE_MODEL: delete_model,
+    REQUEST_MSG.LIST_MODELS: get_models,
+    REQUEST_MSG.AUTHENTICATE: authentication,
+    "syft-command": syft_command,
+    **_USER_HANDLERS,
+}
+
+_socket_handlers: dict[int, SocketHandler] = {}
+
+
+def _handler_of(ctx: NodeContext) -> SocketHandler:
+    return _socket_handlers.setdefault(id(ctx), SocketHandler())
+
+
+def route_requests(
+    ctx: NodeContext, message: str | bytes | bytearray, conn: Connection
+):
+    """(reference events/__init__.py:61-87) one message in, one response out.
+    Binary frames route to the per-user worker; JSON dispatches on `type`;
+    request_id echoes back."""
+    import json
+
+    if isinstance(message, (bytes, bytearray)):
+        return forward_binary_message(ctx, message, conn)
+
+    request_id = None
+    try:
+        parsed = json.loads(message)
+        request_id = parsed.get(MSG_FIELD.REQUEST_ID)
+        handler = ROUTES[parsed[MSG_FIELD.TYPE]]
+        response = handler(ctx, parsed, conn)
+    except Exception as err:  # noqa: BLE001 — protocol boundary
+        response = {ERROR: str(err)}
+    if request_id:
+        response[MSG_FIELD.REQUEST_ID] = request_id
+    return json.dumps(response)

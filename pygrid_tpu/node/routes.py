@@ -1,0 +1,412 @@
+"""Node HTTP routes: model-centric, data-centric, users.
+
+Parity surface: reference ``apps/node/src/app/main/routes/model_centric/
+routes.py`` (cycle-request/speed-test/report/get-protocol/get-model/get-plan/
+authenticate/retrieve-model — see SURVEY.md §2.1) and
+``routes/data_centric/routes.py`` (models/detailed-models-list/identity/
+status/workers/serve-model/dataset-tags/search-encrypted-models/search), plus
+the users HTTP CRUD. Status codes mirror the reference: 400 bad request,
+401 invalid request key, 404 model missing, 500 otherwise.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from pygrid_tpu.node import NodeContext, __version__
+from pygrid_tpu.node.events import (
+    Connection,
+    authenticate as ws_authenticate,
+    cycle_request as ws_cycle_request,
+    report as ws_report,
+    _USER_HANDLERS,
+)
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.serde import deserialize
+from pygrid_tpu.smpc.additive import AdditiveSharingTensor
+from pygrid_tpu.utils import exceptions as E
+from pygrid_tpu.utils.codes import MSG_FIELD
+
+logger = logging.getLogger(__name__)
+
+SPEED_TEST_SAMPLE_BYTES = 64 * 1024 * 1024  # reference: 64MB, routes.py:80-83
+
+
+def _ctx(request: web.Request) -> NodeContext:
+    return request.app["node"]
+
+
+def _json_error(err: Exception, status: int) -> web.Response:
+    return web.json_response({"error": str(err)}, status=status)
+
+
+def _status_for(err: Exception) -> int:
+    if isinstance(err, E.InvalidRequestKeyError):
+        return 401
+    if isinstance(err, (E.ModelNotFoundError, E.CheckPointNotFound)):
+        return 404
+    if isinstance(err, E.PyGridError):
+        return 400
+    return 500
+
+
+# ── model-centric ────────────────────────────────────────────────────────────
+
+
+async def mc_cycle_request(request: web.Request) -> web.Response:
+    """HTTP mirror of the WS cycle-request (reference routes.py:37-60)."""
+    try:
+        body = json.loads(await request.text())
+    except json.JSONDecodeError as err:
+        return _json_error(err, 400)
+    response = ws_cycle_request(
+        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request))
+    )
+    return web.json_response(response[MSG_FIELD.DATA])
+
+
+async def mc_speed_test(request: web.Request) -> web.Response:
+    """(reference routes.py:62-99) download sample / ping / upload sink."""
+    worker_id = request.query.get("worker_id")
+    random = request.query.get("random")
+    is_ping = request.query.get("is_ping")
+    if not worker_id or not random:
+        return _json_error(E.PyGridError(""), 400)
+    if request.method == "GET" and is_ping is None:
+        try:
+            size = int(request.query.get("size", SPEED_TEST_SAMPLE_BYTES))
+        except ValueError as err:
+            return _json_error(err, 400)
+        # unauthenticated endpoint: cap at the reference's 64MB sample
+        size = max(0, min(size, SPEED_TEST_SAMPLE_BYTES))
+        return web.Response(
+            body=b"x" * size, content_type="application/octet-stream"
+        )
+    if request.method == "POST":
+        await request.read()  # upload sink
+    return web.json_response({})
+
+
+async def mc_report(request: web.Request) -> web.Response:
+    try:
+        body = json.loads(await request.text())
+    except json.JSONDecodeError as err:
+        return _json_error(err, 400)
+    response = ws_report(
+        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request))
+    )
+    return web.json_response(response[MSG_FIELD.DATA])
+
+
+async def mc_authenticate(request: web.Request) -> web.Response:
+    try:
+        body = json.loads(await request.text())
+    except json.JSONDecodeError as err:
+        return _json_error(err, 400)
+    response = ws_authenticate(
+        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request))
+    )
+    return web.json_response(response[MSG_FIELD.DATA])
+
+
+def _validated_cycle(ctx: NodeContext, request: web.Request, fl_process_id: int):
+    """request_key gate shared by the three download routes
+    (reference routes.py:163-250)."""
+    worker_id = request.query.get("worker_id")
+    request_key = request.query.get("request_key")
+    cycle = ctx.fl.cycle_manager.last(fl_process_id)
+    worker = ctx.fl.worker_manager.get(id=worker_id)
+    ctx.fl.cycle_manager.validate(worker.id, cycle.id, request_key)
+
+
+async def mc_get_model(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    try:
+        model_id = int(request.query.get("model_id"))
+        model = ctx.fl.model_manager.get(id=model_id)
+        _validated_cycle(ctx, request, model.fl_process_id)
+        checkpoint = ctx.fl.model_manager.load(model_id=model_id)
+        return web.Response(
+            body=checkpoint.value, content_type="application/octet-stream"
+        )
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
+async def mc_get_plan(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    try:
+        plan_id = int(request.query.get("plan_id"))
+        variant = request.query.get("receive_operations_as", "list")
+        plan = ctx.fl.plan_manager.get(id=plan_id, is_avg_plan=False)
+        _validated_cycle(ctx, request, plan.fl_process_id)
+        blob = ctx.fl.plan_manager.get_variant(plan_id, variant)
+        return web.Response(
+            body=blob, content_type="application/octet-stream"
+        )
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
+async def mc_get_protocol(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    try:
+        protocol_id = int(request.query.get("protocol_id"))
+        protocol = ctx.fl.protocol_manager.get(id=protocol_id)
+        _validated_cycle(ctx, request, protocol.fl_process_id)
+        return web.Response(
+            body=protocol.value, content_type="application/octet-stream"
+        )
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
+async def mc_retrieve_model(request: web.Request) -> web.Response:
+    """Public checkpoint download by name/version/checkpoint alias or number
+    (reference routes.py:471-516)."""
+    ctx = _ctx(request)
+    try:
+        filters: dict[str, Any] = {"name": request.query.get("name")}
+        if request.query.get("version"):
+            filters["version"] = request.query.get("version")
+        process = ctx.fl.process_manager.first(**filters)
+        model = ctx.fl.model_manager.get(fl_process_id=process.id)
+        checkpoint_query: dict[str, Any] = {"model_id": model.id}
+        checkpoint = request.query.get("checkpoint")
+        if checkpoint:
+            if checkpoint.isnumeric():
+                checkpoint_query["number"] = int(checkpoint)
+            else:
+                checkpoint_query["alias"] = checkpoint
+        else:
+            checkpoint_query["alias"] = "latest"
+        record = ctx.fl.model_manager.load(**checkpoint_query)
+        return web.Response(
+            body=record.value, content_type="application/octet-stream"
+        )
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
+# ── data-centric ─────────────────────────────────────────────────────────────
+
+
+def _dc_session(request: web.Request):
+    ctx = _ctx(request)
+    token = request.headers.get("token") or request.query.get("token")
+    session = ctx.sessions.by_token(token)
+    if session is None:
+        raise E.AuthorizationError("authentication required")
+    return session
+
+
+async def dc_models(request: web.Request) -> web.Response:
+    """(reference routes.py: /models/) public list of hosted model ids."""
+    ctx = _ctx(request)
+    return web.json_response(
+        {"success": True, "models": ctx.models.models(ctx.local_worker.id)}
+    )
+
+
+async def dc_detailed_models(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    out = []
+    for model_id in ctx.models.models(ctx.local_worker.id):
+        hosted = ctx.models.get(ctx.local_worker.id, model_id)
+        out.append(hosted.flags())
+    return web.json_response({"success": True, "models": out})
+
+
+async def dc_identity(request: web.Request) -> web.Response:
+    return web.json_response(
+        {"identity": _ctx(request).id, "version": __version__}
+    )
+
+
+async def dc_status(request: web.Request) -> web.Response:
+    return web.json_response({"status": "OK"})
+
+
+async def dc_workers(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    workers = [w.id for w in ctx.fl.worker_manager.query()]
+    return web.json_response({"workers": workers})
+
+
+async def dc_serve_model(request: web.Request) -> web.Response:
+    """(reference routes.py:128-169) host a model over HTTP; multipart for
+    big payloads or JSON with base64 body."""
+    ctx = _ctx(request)
+    try:
+        if request.content_type.startswith("multipart/"):
+            reader = await request.multipart()
+            fields: dict[str, Any] = {}
+            async for part in reader:
+                if part.name == "model":
+                    fields["model"] = await part.read(decode=False)
+                else:
+                    fields[part.name] = (await part.text())
+            blob = bytes(fields.pop("model"))
+        else:
+            fields = json.loads(await request.text())
+            blob = base64.b64decode(fields.pop("model"))
+        _dc_session(request)  # hosting requires login
+        result = ctx.models.save(
+            ctx.local_worker.id,
+            blob,
+            fields.get("model_id"),
+            allow_download=str(fields.get("allow_download")) == "True",
+            allow_remote_inference=str(fields.get("allow_remote_inference"))
+            == "True",
+            mpc=str(fields.get("mpc")) == "True",
+        )
+        return web.json_response(result)
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
+async def dc_dataset_tags(request: web.Request) -> web.Response:
+    """(reference routes.py:171-189) all tags across the node's store."""
+    ctx = _ctx(request)
+    tags: set[str] = set()
+    for store in ctx.all_stores():
+        tags |= store.tags()
+    return web.json_response(sorted(tags))
+
+
+def _find_shared_tensors(value: Any) -> list[AdditiveSharingTensor]:
+    """Descend a hosted model / plan state to its AdditiveSharingTensors
+    (reference routes.py:192-250 walks Plan.state tensor chains)."""
+    found = []
+    if isinstance(value, AdditiveSharingTensor):
+        found.append(value)
+    elif isinstance(value, Plan) and value.state is not None:
+        for t in value.state.tensors():
+            found.extend(_find_shared_tensors(t))
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            found.extend(_find_shared_tensors(v))
+    return found
+
+
+async def dc_search_encrypted_models(request: web.Request) -> web.Response:
+    ctx = _ctx(request)
+    try:
+        body = json.loads(await request.text())
+        model_id = body.get("model_id")
+        hosted = ctx.models.get(ctx.local_worker.id, model_id)
+        if not hosted.mpc:
+            raise E.ModelNotFoundError()
+        shared = _find_shared_tensors(hosted.model)
+        if not shared:
+            raise E.ModelNotFoundError()
+        workers = sorted({o for t in shared for o in t.owners})
+        providers = sorted(
+            {
+                t.crypto_provider_id
+                for t in shared
+                if t.crypto_provider_id is not None
+            }
+        )
+        return web.json_response(
+            {
+                "success": True,
+                "workers": workers,
+                "crypto_provider": providers,
+            }
+        )
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
+async def dc_search(request: web.Request) -> web.Response:
+    """(reference routes.py:253-273) tag search over the node's store."""
+    ctx = _ctx(request)
+    try:
+        body = json.loads(await request.text())
+        query = body.get("query") or []
+        found = [o for store in ctx.all_stores() for o in store.search(query)]
+        return web.json_response(
+            {"content": bool(found), "count": len(found)}
+        )
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
+# ── users HTTP CRUD (reference routes/{user,role,group}_related.py) ──────────
+
+
+def _ws_twin(event_type: str):
+    async def handler(request: web.Request) -> web.Response:
+        ctx = _ctx(request)
+        try:
+            data = json.loads(await request.text()) if request.can_read_body else {}
+        except json.JSONDecodeError as err:
+            return _json_error(err, 400)
+        token = request.headers.get("token")
+        if token and "token" not in data:
+            data["token"] = token
+        data.update(
+            {k: v for k, v in request.match_info.items() if k not in data}
+        )
+        response = _USER_HANDLERS[event_type](
+            ctx, {MSG_FIELD.DATA: data}, Connection(ctx)
+        )
+        status = 200 if "error" not in response else 400
+        return web.json_response(response, status=status)
+
+    return handler
+
+
+# ── registration ─────────────────────────────────────────────────────────────
+
+
+def register(app: web.Application) -> None:
+    r = app.router
+    # model-centric (reference blueprint /model-centric)
+    r.add_post("/model-centric/cycle-request", mc_cycle_request)
+    r.add_route("*", "/model-centric/speed-test", mc_speed_test)
+    r.add_post("/model-centric/report", mc_report)
+    r.add_post("/model-centric/authenticate", mc_authenticate)
+    r.add_get("/model-centric/get-model", mc_get_model)
+    r.add_get("/model-centric/get-plan", mc_get_plan)
+    r.add_get("/model-centric/get-protocol", mc_get_protocol)
+    r.add_get("/model-centric/retrieve-model", mc_retrieve_model)
+    # data-centric (reference blueprint /data-centric)
+    r.add_get("/data-centric/models/", dc_models)
+    r.add_get("/data-centric/detailed-models-list/", dc_detailed_models)
+    r.add_get("/data-centric/identity/", dc_identity)
+    r.add_get("/data-centric/status/", dc_status)
+    r.add_get("/data-centric/workers/", dc_workers)
+    r.add_post("/data-centric/serve-model/", dc_serve_model)
+    r.add_get("/data-centric/dataset-tags", dc_dataset_tags)
+    r.add_post("/data-centric/search-encrypted-models", dc_search_encrypted_models)
+    r.add_post("/data-centric/search", dc_search)
+    # users
+    from pygrid_tpu.utils.codes import GROUP_EVENTS, ROLE_EVENTS, USER_EVENTS
+
+    r.add_post("/users/signup", _ws_twin(USER_EVENTS.SIGNUP_USER))
+    r.add_post("/users/login", _ws_twin(USER_EVENTS.LOGIN_USER))
+    r.add_get("/users/", _ws_twin(USER_EVENTS.GET_ALL_USERS))
+    r.add_get("/users/{id}", _ws_twin(USER_EVENTS.GET_SPECIFIC_USER))
+    r.add_post("/users/search", _ws_twin(USER_EVENTS.SEARCH_USERS))
+    r.add_put("/users/{id}/email", _ws_twin(USER_EVENTS.PUT_EMAIL))
+    r.add_put("/users/{id}/password", _ws_twin(USER_EVENTS.PUT_PASSWORD))
+    r.add_put("/users/{id}/role", _ws_twin(USER_EVENTS.PUT_ROLE))
+    r.add_put("/users/{id}/groups", _ws_twin(USER_EVENTS.PUT_GROUPS))
+    r.add_delete("/users/{id}", _ws_twin(USER_EVENTS.DELETE_USER))
+    r.add_post("/roles/", _ws_twin(ROLE_EVENTS.CREATE_ROLE))
+    r.add_get("/roles/", _ws_twin(ROLE_EVENTS.GET_ALL_ROLES))
+    r.add_get("/roles/{id}", _ws_twin(ROLE_EVENTS.GET_ROLE))
+    r.add_put("/roles/{id}", _ws_twin(ROLE_EVENTS.PUT_ROLE))
+    r.add_delete("/roles/{id}", _ws_twin(ROLE_EVENTS.DELETE_ROLE))
+    r.add_post("/groups/", _ws_twin(GROUP_EVENTS.CREATE_GROUP))
+    r.add_get("/groups/", _ws_twin(GROUP_EVENTS.GET_ALL_GROUPS))
+    r.add_get("/groups/{id}", _ws_twin(GROUP_EVENTS.GET_GROUP))
+    r.add_put("/groups/{id}", _ws_twin(GROUP_EVENTS.PUT_GROUP))
+    r.add_delete("/groups/{id}", _ws_twin(GROUP_EVENTS.DELETE_GROUP))

@@ -1,0 +1,51 @@
+"""The Node's WebSocket endpoint.
+
+Parity surface: reference ``events/__init__.py:90-107`` (``socket_api``: one
+WS route at ``/``; JSON and binary frames through ``route_requests``; worker
+unbound on socket close) served by gevent-websocket. Here: aiohttp WS with
+the blocking handler work pushed to the default executor so jax/sqlite calls
+never stall the event loop. The reference's numpy XOR-masking fast path
+(``util.py:5-24``) corresponds to the native masking extension in
+``pygrid_tpu/native`` (aiohttp itself masks frames in C already).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import WSMsgType, web
+
+from pygrid_tpu.node.events import Connection, _handler_of, route_requests
+
+
+async def ws_handler(request: web.Request) -> web.StreamResponse:
+    ctx = request.app["node"]
+    if (
+        request.headers.get("Upgrade", "").lower() != "websocket"
+    ):  # plain GET / → landing info (reference serves the dashboard here)
+        return web.json_response(
+            {"node_id": ctx.id, "message": "pygrid-tpu node"}
+        )
+
+    ws = web.WebSocketResponse(max_msg_size=256 * 1024 * 1024)
+    await ws.prepare(request)
+    conn = Connection(ctx, socket=ws)
+    loop = asyncio.get_running_loop()
+    try:
+        async for msg in ws:
+            if msg.type == WSMsgType.TEXT:
+                payload: str | bytes = msg.data
+            elif msg.type == WSMsgType.BINARY:
+                payload = bytearray(msg.data)
+            else:
+                continue
+            response = await loop.run_in_executor(
+                None, route_requests, ctx, payload, conn
+            )
+            if isinstance(response, (bytes, bytearray)):
+                await ws.send_bytes(bytes(response))
+            elif response is not None:
+                await ws.send_str(response)
+    finally:
+        _handler_of(ctx).remove(ws)
+    return ws

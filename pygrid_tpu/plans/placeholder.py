@@ -47,9 +47,15 @@ class PlaceHolder:
         return self
 
     def _bufferize(self) -> dict:
+        # serde-registered wrappers (AdditiveSharingTensor, nested Plans…)
+        # travel as themselves; only raw device arrays are host-coerced —
+        # np.asarray on a wrapper would build an object ndarray
+        tensor = self.tensor
+        if tensor is not None and not hasattr(tensor, "_bufferize"):
+            tensor = np.asarray(tensor)
         return {
             "id": self.id,
-            "tensor": None if self.tensor is None else np.asarray(self.tensor),
+            "tensor": tensor,
             "tags": sorted(self.tags),
             "description": self.description,
         }

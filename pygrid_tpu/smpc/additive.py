@@ -50,6 +50,12 @@ class AdditiveSharingTensor:
         self.owners = tuple(owners)
         self.encoder = encoder
         self.crypto_provider = crypto_provider
+        #: survives serde even when the live provider object doesn't — the
+        #: encrypted-model discovery path reports it (reference
+        #: routes/data_centric/routes.py:215-236)
+        self.crypto_provider_id: str | None = (
+            crypto_provider.id if crypto_provider is not None else None
+        )
 
     # --- construction -------------------------------------------------------
 
@@ -183,6 +189,7 @@ class AdditiveSharingTensor:
             "owners": list(self.owners),
             "base": self.encoder.base if self.encoder else None,
             "precision": self.encoder.precision_fractional if self.encoder else None,
+            "crypto_provider_id": self.crypto_provider_id,
         }
 
     @classmethod
@@ -190,11 +197,13 @@ class AdditiveSharingTensor:
         encoder = None
         if data["base"] is not None:
             encoder = FixedPointEncoder(data["base"], data["precision"])
-        return cls(
+        out = cls(
             R.Ring64(jnp.asarray(data["lo"]), jnp.asarray(data["hi"])),
             data["owners"],
             encoder,
         )
+        out.crypto_provider_id = data.get("crypto_provider_id")
+        return out
 
     def __repr__(self) -> str:
         return (

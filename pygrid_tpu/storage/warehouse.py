@@ -105,7 +105,7 @@ class Warehouse:
             raise TypeError("Warehouse schema must be a dataclass")
         self.schema = schema
         self.db = db
-        self.table = schema.__name__.lower()
+        self.table = '"' + schema.__name__.lower() + '"'  # quoted: "group"/"user" are reserved words
         self.fields = dataclasses.fields(schema)
         self._field_types = {f.name: f.type for f in self.fields}
         self._create_table()
@@ -113,7 +113,7 @@ class Warehouse:
     def _create_table(self) -> None:
         cols = []
         for f in self.fields:
-            col = f"{f.name} {_column_type(f.type)}"
+            col = f'"{f.name}" {_column_type(f.type)}'
             if f.name == "id":
                 if _column_type(f.type) == "INTEGER":
                     col = "id INTEGER PRIMARY KEY AUTOINCREMENT"
@@ -133,7 +133,7 @@ class Warehouse:
             v = getattr(obj, f.name)
             if f.name == "id" and v is None:
                 continue
-            names.append(f.name)
+            names.append(f'"{f.name}"')
             values.append(_encode(v, f.type))
         sql = (
             f"INSERT INTO {self.table} ({', '.join(names)}) "
@@ -146,7 +146,7 @@ class Warehouse:
 
     def modify(self, filters: dict, updates: dict) -> None:
         where, params = self._where(filters)
-        sets = ", ".join(f"{k} = ?" for k in updates)
+        sets = ", ".join(f'"{k}" = ?' for k in updates)
         set_params = tuple(
             _encode(v, self._field_types.get(k)) for k, v in updates.items()
         )
@@ -168,9 +168,9 @@ class Warehouse:
         clauses, params = [], []
         for k, v in filters.items():
             if v is None:
-                clauses.append(f"{k} IS NULL")
+                clauses.append(f'"{k}" IS NULL')
             else:
-                clauses.append(f"{k} = ?")
+                clauses.append(f'"{k}" = ?')
                 params.append(_encode(v, self._field_types.get(k)))
         return " WHERE " + " AND ".join(clauses), tuple(params)
 
@@ -184,7 +184,7 @@ class Warehouse:
 
     def query(self, order_by: str | None = None, **filters: Any) -> list[T]:
         where, params = self._where(filters)
-        order = f" ORDER BY {order_by}" if order_by else ""
+        order = f' ORDER BY "{order_by}"' if order_by else ""
         cur = self.db.execute(
             f"SELECT * FROM {self.table}{where}{order}", params
         )

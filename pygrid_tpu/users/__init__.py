@@ -1,0 +1,14 @@
+"""RBAC / user management, shared by the Node and Network apps.
+
+Parity surface: reference ``apps/node/src/app/main/{users,database,auth}.py``
+(~1200 LoC) and the Network twin (``apps/network/src/app/users/``): bcrypt-
+salted signup/login (pbkdf2 here — no bcrypt in the image), first user
+auto-Owner, JWT HS256 session tokens, role-boolean permission gates, group
+membership, and a transport-agnostic ``token_required`` resolver used by
+both the HTTP routes and their WS event twins.
+"""
+
+from pygrid_tpu.users.ops import UserManager, seed_roles
+from pygrid_tpu.users.schemas import Group, Role, User, UserGroup
+
+__all__ = ["UserManager", "seed_roles", "Group", "Role", "User", "UserGroup"]

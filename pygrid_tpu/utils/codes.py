@@ -61,7 +61,10 @@ class USER_EVENTS:
     SEARCH_USERS = "search-users"
     PUT_EMAIL = "put-email"
     PUT_PASSWORD = "put-password"
-    PUT_ROLE = "put-role"
+    # the reference assigns "put-role" to BOTH this and ROLE_EVENTS.PUT_ROLE
+    # (core/codes.py:43,54), which makes user-role changes unreachable in its
+    # WS table; disambiguated here
+    PUT_ROLE = "put-user-role"
     PUT_GROUPS = "put-groups"
     DELETE_USER = "delete-user"
     SIGNUP_USER = "signup-user"

@@ -1,0 +1,138 @@
+"""Data-centric grid ops over real WebSockets.
+
+Mirrors reference ``tests/data_centric/test_basic_syft_operations.py``:
+send/get/search/tag, remote pointer arithmetic, permissioned (private)
+tensors, move between nodes, hosted-model serve + remote inference.
+"""
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.client import DataCentricFLClient
+from pygrid_tpu.plans.plan import func2plan
+from pygrid_tpu.utils.exceptions import GetNotPermittedError, PyGridError
+
+
+@pytest.fixture(scope="module")
+def alice(grid):
+    client = DataCentricFLClient(grid.node_url("alice"))
+    yield client
+    client.close()
+
+
+@pytest.fixture(scope="module")
+def bob(grid):
+    client = DataCentricFLClient(grid.node_url("bob"))
+    yield client
+    client.close()
+
+
+def test_node_identity(alice):
+    infos = alice.get_node_infos()
+    assert infos["id"] == "alice"
+
+
+def test_ping(alice):
+    assert alice.ping()
+
+
+def test_send_get(alice):
+    x = np.array([1.0, 2.0, 3.0])
+    ptr = alice.send(x, tags={"#test-send"})
+    assert ptr.shape == (3,)
+    np.testing.assert_array_equal(ptr.get(), x)
+
+
+def test_send_search_by_tag(alice):
+    alice.send(np.ones((2, 2)), tags={"#mnist", "#data"}, description="d")
+    found = alice.search("#mnist", "#data")
+    assert len(found) == 1
+    assert found[0].shape == (2, 2)
+    np.testing.assert_array_equal(found[0].get(delete=False), np.ones((2, 2)))
+
+
+def test_remote_arithmetic(alice):
+    a = alice.send(np.array([2.0, 4.0]))
+    b = alice.send(np.array([10.0, 20.0]))
+    np.testing.assert_array_equal((a + b).get(), [12.0, 24.0])
+    np.testing.assert_array_equal((b - a).get(delete=False), [8.0, 16.0])
+    c = alice.send(np.eye(2))
+    d = alice.send(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    np.testing.assert_array_equal((c @ d).get(), [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_private_tensor_permissions(alice):
+    ptr = alice.send(np.array([42.0]), allowed_users={"someone-else"})
+    with pytest.raises(GetNotPermittedError):
+        ptr.get()
+
+
+def test_garbage_collect_on_get(alice):
+    ptr = alice.send(np.arange(3.0), tags={"#gc-test"})
+    ptr.get(delete=True)
+    assert alice.search("#gc-test") == []
+
+
+def test_move_between_nodes(alice, bob):
+    alice.connect_nodes(bob)
+    ptr = alice.send(np.array([7.0, 8.0]), tags={"#movable"})
+    moved = ptr.move(bob)
+    np.testing.assert_array_equal(moved.get(), [7.0, 8.0])
+    # origin copy is gone
+    assert alice.search("#movable") == []
+
+
+def test_serve_and_remote_inference(alice):
+    @func2plan(args_shape=[(1, 4)])
+    def triple(x):
+        return x * 3.0
+
+    result = alice.serve_model(
+        triple, "triple-model", allow_remote_inference=True
+    )
+    assert result.get("success")
+    assert "triple-model" in alice.models
+    pred = alice.run_remote_inference(
+        "triple-model", np.ones((1, 4), np.float32)
+    )
+    np.testing.assert_allclose(pred, 3 * np.ones((1, 4)))
+
+
+def test_inference_not_allowed(alice):
+    @func2plan(args_shape=[(1, 2)])
+    def private_model(x):
+        return x
+
+    alice.serve_model(private_model, "no-inference-model")
+    with pytest.raises(PyGridError):
+        alice.run_remote_inference(
+            "no-inference-model", np.ones((1, 2), np.float32)
+        )
+
+
+def test_delete_model(alice):
+    @func2plan(args_shape=[(1, 2)])
+    def doomed(x):
+        return x
+
+    alice.serve_model(doomed, "doomed-model")
+    assert "doomed-model" in alice.models
+    alice.delete_model("doomed-model")
+    assert "doomed-model" not in alice.models
+
+
+def test_duplicate_model_id_rejected(alice):
+    @func2plan(args_shape=[(1, 2)])
+    def dup(x):
+        return x
+
+    alice.serve_model(dup, "dup-model")
+    response = alice.serve_model(dup, "dup-model")
+    assert not response.get("success", False)
+
+
+def test_bad_login(grid):
+    with pytest.raises(PyGridError):
+        DataCentricFLClient(
+            grid.node_url("alice"), username="admin", password="wrong"
+        )

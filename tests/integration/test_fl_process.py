@@ -1,0 +1,179 @@
+"""Model-centric FL protocol over real WebSockets.
+
+Mirrors reference ``tests/model_centric/test_fl_process.py``
+(ModelCentricAPISocketsTest:100-399): host → authenticate (JWT negative +
+positive) → cycle-request (speed matrix) → model/plan download → report →
+server-side FedAvg aggregation → next cycle + checkpoint retrieval.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pygrid_tpu.client import FLClient, ModelCentricFLClient
+from pygrid_tpu.federated.auth import jwt_encode
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+
+SECRET = "very-secret-hmac-key"
+NAME, VERSION = "mnist", "1.0"
+D, H, C, B = 28 * 28, 32, 10, 8
+
+
+def make_plans_and_params():
+    params = mlp.init(jax.random.PRNGKey(7), (D, H, C))
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *[np.asarray(p) for p in params],
+    )
+    return [np.asarray(p) for p in params], plan
+
+
+@pytest.fixture(scope="module")
+def hosted(grid):
+    """Host the FL process on alice (reference test :100-141)."""
+    params, plan = make_plans_and_params()
+    client = ModelCentricFLClient(grid.node_url("alice"))
+    response = client.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": NAME,
+            "version": VERSION,
+            "batch_size": B,
+            "lr": 0.1,
+            "max_updates": 2,
+        },
+        server_config={
+            "min_workers": 2,
+            "max_workers": 4,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+            "cycle_length": 28800,
+            "num_cycles": 4,
+            "max_diffs": 2,
+            "min_diffs": 2,
+            "authentication": {"secret": SECRET},
+        },
+    )
+    assert response.get("status") == "success"
+    client.close()
+    return {"params": params, "plan": plan}
+
+
+def test_host_conflict_rejected(grid, hosted):
+    params, plan = make_plans_and_params()
+    client = ModelCentricFLClient(grid.node_url("alice"))
+    import pytest as _pytest
+
+    from pygrid_tpu.utils.exceptions import PyGridError
+
+    with _pytest.raises(PyGridError):
+        client.host_federated_training(
+            model=params,
+            client_plans={"training_plan": plan},
+            client_config={"name": NAME, "version": VERSION},
+            server_config={},
+        )
+    client.close()
+
+
+def test_authenticate_rejects_bad_token(grid, hosted):
+    client = FLClient(grid.node_url("alice"), auth_token="garbage.token.here")
+    auth = client.authenticate(NAME, VERSION)
+    assert "error" in auth
+    client.close()
+
+
+def test_authenticate_requires_token(grid, hosted):
+    client = FLClient(grid.node_url("alice"), auth_token=None)
+    auth = client.authenticate(NAME, VERSION)
+    assert "error" in auth
+    client.close()
+
+
+def _token() -> str:
+    return jwt_encode({"sub": "worker"}, secret=SECRET)
+
+
+def test_authenticate_accepts_valid_jwt(grid, hosted):
+    client = FLClient(grid.node_url("alice"), auth_token=_token())
+    auth = client.authenticate(NAME, VERSION)
+    assert auth.get("status") == "success"
+    assert auth.get("worker_id")
+    # no speed minimums configured → no speed test required
+    assert auth.get("requires_speed_test") is False
+    client.close()
+
+
+def test_cycle_request_rejects_negative_speed(grid, hosted):
+    client = FLClient(grid.node_url("alice"), auth_token=_token())
+    auth = client.authenticate(NAME, VERSION)
+    cycle = client.cycle_request(
+        auth["worker_id"], NAME, VERSION, ping=-5, download=1.0, upload=1.0
+    )
+    assert cycle["status"] == "rejected"
+    assert "positive number" in cycle.get("error", "")
+    client.close()
+
+
+def test_full_fedavg_round_over_sockets(grid, hosted):
+    """The north-star path (SURVEY §3.3 steps 3-7): two workers train and
+    report; the node aggregates and writes checkpoint 2."""
+    initial = hosted["params"]
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, B)]
+
+    reported = []
+    jobs = []
+    for _ in range(2):
+        client = FLClient(grid.node_url("alice"), auth_token=_token())
+        job = client.new_job(NAME, VERSION)
+
+        def on_accept(job):
+            plan = job.plans["training_plan"]
+            params = [np.asarray(p) for p in job.model_params]
+            lr = np.float32(job.client_config.get("lr", 0.1))
+            out = plan(X, y, lr, *params)
+            new_params = [np.asarray(t) for t in out[2:]]
+            diff = [p - n for p, n in zip(params, new_params)]
+            job.report(diff)
+            reported.append(True)
+
+        job.add_listener(job.EVENT_ACCEPTED, on_accept)
+        job.add_listener(
+            job.EVENT_ERROR, lambda j, e: pytest.fail(f"job error: {e}")
+        )
+        job.start()
+        jobs.append((client, job))
+
+    assert len(reported) == 2
+    # aggregation ran synchronously → checkpoint 2 exists and moved
+    mc = ModelCentricFLClient(grid.node_url("alice"))
+    latest = mc.retrieve_model(NAME, VERSION)
+    assert any(
+        not np.allclose(a, b) for a, b in zip(latest, initial)
+    ), "aggregation did not change params"
+    first = mc.retrieve_model(NAME, VERSION, checkpoint=1)
+    for a, b in zip(first, initial):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    # second worker in the same (new) cycle sees rejection after assignment
+    for client, job in jobs:
+        client.close()
+    mc.close()
+
+
+def test_worker_already_in_cycle_rejected(grid, hosted):
+    client = FLClient(grid.node_url("alice"), auth_token=_token())
+    auth = client.authenticate(NAME, VERSION)
+    wid = auth["worker_id"]
+    first = client.cycle_request(wid, NAME, VERSION, 1.0, 100.0, 100.0)
+    assert first["status"] == "accepted"
+    again = client.cycle_request(wid, NAME, VERSION, 1.0, 100.0, 100.0)
+    assert again["status"] == "rejected"
+    client.close()

@@ -1,0 +1,168 @@
+"""Network directory + fan-out search over the 4-node grid.
+
+Mirrors reference ``apps/network/tests/rest_api/`` (join, connected-nodes,
+choose-model-host, search) plus the PublicGridNetwork client flow from the
+data-centric MNIST example.
+"""
+
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from pygrid_tpu.client import DataCentricFLClient, PublicGridNetwork
+from pygrid_tpu.plans.plan import func2plan
+from pygrid_tpu.smpc.additive import fix_prec
+from pygrid_tpu.smpc.provider import CryptoProvider
+
+
+def test_connected_nodes(grid):
+    resp = requests.get(grid.network_url + "/connected-nodes", timeout=10)
+    assert set(resp.json()["grid-nodes"]) == {"alice", "bob", "charlie", "dan"}
+
+
+def test_join_duplicate_id_conflict(grid):
+    resp = requests.post(
+        grid.network_url + "/join",
+        json={"node-id": "alice", "node-address": "http://x"},
+        timeout=10,
+    )
+    assert resp.status_code == 409
+
+
+def test_join_invalid_json(grid):
+    resp = requests.post(
+        grid.network_url + "/join", data="not json", timeout=10
+    )
+    assert resp.status_code == 400
+
+
+def test_choose_model_host(grid):
+    resp = requests.get(grid.network_url + "/choose-model-host", timeout=10)
+    hosts = resp.json()
+    assert len(hosts) == 1
+    node_id, address = hosts[0]
+    assert node_id in {"alice", "bob", "charlie", "dan"}
+    assert address.startswith("http://")
+
+
+def test_choose_encrypted_model_host(grid):
+    """n_replica(1) × SMPC_HOST_CHUNK(4) nodes sampled
+    (reference network.py:98-131)."""
+    resp = requests.get(
+        grid.network_url + "/choose-encrypted-model-host", timeout=10
+    )
+    hosts = resp.json()
+    assert len(hosts) == 4
+    assert {h[0] for h in hosts} == {"alice", "bob", "charlie", "dan"}
+
+
+def test_search_dataset_fanout(grid):
+    """Tag search fans out to every node (reference network.py:266-306)."""
+    charlie = DataCentricFLClient(grid.node_url("charlie"))
+    dan = DataCentricFLClient(grid.node_url("dan"))
+    charlie.send(np.ones(4), tags={"#grid-search-x"})
+    dan.send(np.zeros(4), tags={"#grid-search-x"})
+
+    resp = requests.post(
+        grid.network_url + "/search",
+        json={"query": ["#grid-search-x"]},
+        timeout=15,
+    )
+    matches = resp.json()["match-nodes"]
+    assert {m[0] for m in matches} == {"charlie", "dan"}
+    charlie.close()
+    dan.close()
+
+
+def test_search_available_tags(grid):
+    bob = DataCentricFLClient(grid.node_url("bob"))
+    bob.send(np.ones(2), tags={"#network-tag-test"})
+    resp = requests.get(
+        grid.network_url + "/search-available-tags", timeout=15
+    )
+    assert "#network-tag-test" in resp.json()["tags"]
+    bob.close()
+
+
+def test_search_available_models_and_search_model(grid):
+    bob = DataCentricFLClient(grid.node_url("bob"))
+
+    @func2plan(args_shape=[(1, 2)])
+    def m(x):
+        return x
+
+    bob.serve_model(m, "network-visible-model")
+    resp = requests.get(
+        grid.network_url + "/search-available-models", timeout=15
+    )
+    assert "network-visible-model" in resp.json()["models"]
+
+    resp = requests.post(
+        grid.network_url + "/search-model",
+        json={"model_id": "network-visible-model"},
+        timeout=15,
+    )
+    assert [m[0] for m in resp.json()["match-nodes"]] == ["bob"]
+    bob.close()
+
+
+def test_search_encrypted_model_fanout(grid):
+    """Encrypted-model discovery: a hosted mpc Plan's share-holders surface
+    through the network (reference network.py:157-198 → node routes
+    :192-250)."""
+    alice = DataCentricFLClient(grid.node_url("alice"))
+
+    @func2plan(args_shape=[(1, 2)])
+    def secret_model(x):
+        return x * 2.0
+
+    provider = CryptoProvider(id="james")
+    shared_weights = fix_prec(np.array([[1.0, 2.0]])).share(
+        "alice", "bob", "charlie", crypto_provider=provider
+    )
+    from pygrid_tpu.plans.state import State
+
+    secret_model.state = State.from_tensors([shared_weights])
+    alice.serve_model(secret_model, "encrypted-model", mpc=True)
+
+    resp = requests.post(
+        grid.network_url + "/search-encrypted-model",
+        json={"model_id": "encrypted-model"},
+        timeout=15,
+    )
+    match = resp.json()["match-nodes"]
+    assert "alice" in match
+    assert set(match["alice"]["nodes"]["workers"]) == {
+        "alice", "bob", "charlie"
+    }
+    assert match["alice"]["nodes"]["crypto_provider"] == ["james"]
+    alice.close()
+
+
+def test_public_grid_network_search(grid):
+    dan = DataCentricFLClient(grid.node_url("dan"))
+    dan.send(np.arange(6.0).reshape(2, 3), tags={"#pgn", "#target"})
+    network = PublicGridNetwork(grid.network_url)
+    results = network.search("#pgn", "#target")
+    assert "dan" in results
+    np.testing.assert_array_equal(
+        results["dan"][0].get(delete=False), np.arange(6.0).reshape(2, 3)
+    )
+    network.close()
+    dan.close()
+
+
+def test_monitor_marks_nodes_online(grid):
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        statuses = requests.get(
+            grid.network_url + "/nodes-status", timeout=10
+        ).json()
+        if statuses and all(
+            s["status"] == "online" for s in statuses.values()
+        ):
+            return
+        time.sleep(0.3)
+    pytest.fail(f"nodes never came online: {statuses}")

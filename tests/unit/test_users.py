@@ -1,0 +1,159 @@
+"""RBAC/user management tests.
+
+Mirrors the reference's users test coverage (apps/node/tests, SURVEY.md §4):
+seeded roles, first-user-auto-Owner, permission-gated CRUD, owner-protection
+rules, login token round-trip.
+"""
+
+import pytest
+
+from pygrid_tpu.storage.warehouse import Database
+from pygrid_tpu.users import UserManager
+from pygrid_tpu.utils.exceptions import (
+    AuthorizationError,
+    GroupNotFoundError,
+    InvalidCredentialsError,
+    RoleNotFoundError,
+    UserNotFoundError,
+)
+
+
+@pytest.fixture
+def um():
+    return UserManager(Database(":memory:"), secret_key="test-secret")
+
+
+@pytest.fixture
+def owner(um):
+    return um.signup("owner@node.org", "pw-owner")
+
+
+def test_seed_roles(um):
+    names = [r.name for r in um.roles.query()]
+    assert names == ["User", "Compliance Officer", "Administrator", "Owner"]
+    owner_role = um.roles.first(name="Owner")
+    assert owner_role.can_edit_roles and owner_role.can_manage_nodes
+    user_role = um.roles.first(name="User")
+    assert not any(
+        getattr(user_role, f)
+        for f in vars(user_role)
+        if f.startswith("can_")
+    )
+
+
+def test_first_user_is_owner(um, owner):
+    assert um.role_of(owner).name == "Owner"
+
+
+def test_second_user_defaults_to_user_role(um, owner):
+    u = um.signup("ds@node.org", "pw")
+    assert um.role_of(u).name == "User"
+
+
+def test_creator_can_assign_role(um, owner):
+    admin_role = um.roles.first(name="Administrator")
+    u = um.signup(
+        "admin@node.org", "pw", role=admin_role.id,
+        private_key=owner.private_key,
+    )
+    assert um.role_of(u).name == "Administrator"
+
+
+def test_unprivileged_cannot_assign_role(um, owner):
+    u = um.signup("pleb@node.org", "pw")
+    admin_role = um.roles.first(name="Administrator")
+    u2 = um.signup(
+        "sneaky@node.org", "pw", role=admin_role.id, private_key=u.private_key
+    )
+    assert um.role_of(u2).name == "User"  # silently demoted, per reference
+
+
+def test_login_and_token_roundtrip(um, owner):
+    token = um.login("owner@node.org", "pw-owner")
+    assert um.resolve_token(token).id == owner.id
+    with pytest.raises(InvalidCredentialsError):
+        um.login("owner@node.org", "wrong")
+    with pytest.raises(InvalidCredentialsError):
+        um.resolve_token("not.a.token")
+
+
+def test_read_gates(um, owner):
+    pleb = um.signup("pleb@node.org", "pw")
+    assert len(um.get_all_users(owner)) == 2
+    with pytest.raises(AuthorizationError):
+        um.get_all_users(pleb)
+    with pytest.raises(AuthorizationError):
+        um.get_user(pleb, owner.id)
+    assert um.get_user(owner, pleb.id).email == "pleb@node.org"
+
+
+def test_self_edit_allowed_other_edit_gated(um, owner):
+    pleb = um.signup("pleb@node.org", "pw")
+    um.change_email(pleb, pleb.id, "new@node.org")
+    assert um.users.first(id=pleb.id).email == "new@node.org"
+    other = um.signup("other@node.org", "pw")
+    with pytest.raises(AuthorizationError):
+        um.change_email(pleb, other.id, "hax@node.org")
+    um.change_email(owner, other.id, "fixed@node.org")
+
+
+def test_password_change_relogin(um, owner):
+    um.change_password(owner, owner.id, "pw2")
+    with pytest.raises(InvalidCredentialsError):
+        um.login("owner@node.org", "pw-owner")
+    assert um.login("owner@node.org", "pw2")
+
+
+def test_owner_role_protections(um, owner):
+    pleb = um.signup("pleb@node.org", "pw")
+    admin = um.signup(
+        "adm@node.org", "pw",
+        role=um.roles.first(name="Administrator").id,
+        private_key=owner.private_key,
+    )
+    # user id 1 (Owner account) immutable
+    with pytest.raises(AuthorizationError):
+        um.change_role(owner, owner.id, um.roles.first(name="User").id)
+    # only Owners mint Owners
+    with pytest.raises(AuthorizationError):
+        um.change_role(admin, pleb.id, um.roles.first(name="Owner").id)
+    um.change_role(owner, pleb.id, um.roles.first(name="Owner").id)
+    assert um.role_of(um.users.first(id=pleb.id)).name == "Owner"
+
+
+def test_role_crud_gates(um, owner):
+    pleb = um.signup("pleb@node.org", "pw")
+    with pytest.raises(AuthorizationError):
+        um.create_role(pleb, name="Evil")
+    role = um.create_role(owner, name="Auditor", can_triage_requests=True)
+    assert um.get_role(owner, role.id).name == "Auditor"
+    um.put_role(owner, role.id, name="Auditor2")
+    assert um.roles.first(id=role.id).name == "Auditor2"
+    um.delete_role(owner, role.id)
+    with pytest.raises(RoleNotFoundError):
+        um.get_role(owner, role.id)
+
+
+def test_group_crud_and_membership(um, owner):
+    g1 = um.create_group(owner, "hospital-a")
+    g2 = um.create_group(owner, "hospital-b")
+    pleb = um.signup("pleb@node.org", "pw")
+    um.change_groups(owner, pleb.id, [g1.id, g2.id])
+    assert {g.name for g in um.user_groups(pleb.id)} == {
+        "hospital-a", "hospital-b"
+    }
+    um.change_groups(owner, pleb.id, [g2.id])
+    assert [g.name for g in um.user_groups(pleb.id)] == ["hospital-b"]
+    with pytest.raises(GroupNotFoundError):
+        um.change_groups(owner, pleb.id, [999])
+    um.delete_group(owner, g2.id)
+    assert um.user_groups(pleb.id) == []
+    with pytest.raises(AuthorizationError):
+        um.create_group(pleb, "x")
+
+
+def test_delete_user(um, owner):
+    pleb = um.signup("pleb@node.org", "pw")
+    um.delete_user(owner, pleb.id)
+    with pytest.raises(UserNotFoundError):
+        um.get_user(owner, pleb.id)
