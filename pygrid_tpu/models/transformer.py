@@ -1,0 +1,135 @@
+"""Decoder-only transformer — the long-context flagship model family.
+
+The reference's model zoo stops at MNIST MLP/CNN (SURVEY.md §5.7); this adds
+the transformer family the TPU framework needs for long-context work. Same
+pure-functional convention as :mod:`pygrid_tpu.models.mlp`: ``init`` returns
+a flat list of arrays (so the model drops into Plans, FedAvg rounds, and
+State serde unchanged), ``make_training_step`` builds the
+``(X, y, lr, *params) -> (loss, acc, *new_params)`` plan-traceable step.
+
+The attention implementation is injectable: pass
+``attn_fn=partial(ring_attention, mesh=mesh)`` (or ``ulysses_attention``)
+from :mod:`pygrid_tpu.parallel.ring_attention` to run the same model
+sequence-parallel over a mesh — the model code does not change.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from pygrid_tpu.parallel.ring_attention import attention
+
+
+class TransformerConfig(NamedTuple):
+    vocab: int = 128
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_len: int = 256
+
+
+PARAMS_PER_LAYER = 12  # ln1(2) + attn(4) + ln2(2) + mlp(4)
+N_GLOBAL = 4  # embed, pos, ln_f scale/bias
+
+
+def init(key: jax.Array, cfg: TransformerConfig = TransformerConfig()) -> list[jax.Array]:
+    """Flat param list: [embed, pos, (12 per layer)*n_layers, ln_f_s, ln_f_b].
+
+    Output projection is tied to the embedding (logits = h @ embed.T).
+    """
+    d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
+    keys = iter(jax.random.split(key, 2 + 6 * cfg.n_layers))
+    sd = d**-0.5
+    params: list[jax.Array] = [
+        jax.random.normal(next(keys), (cfg.vocab, d)) * sd,
+        jax.random.normal(next(keys), (cfg.max_len, d)) * sd,
+    ]
+    for _ in range(cfg.n_layers):
+        params += [jnp.ones((d,)), jnp.zeros((d,))]  # ln1
+        for shape in ((d, d), (d, d), (d, d), (d, d)):  # wq wk wv wo
+            params.append(jax.random.normal(next(keys), shape) * sd)
+        params += [jnp.ones((d,)), jnp.zeros((d,))]  # ln2
+        params += [
+            jax.random.normal(next(keys), (d, f)) * sd,
+            jnp.zeros((f,)),
+            jax.random.normal(next(keys), (f, d)) * f**-0.5,
+            jnp.zeros((d,)),
+        ]
+    params += [jnp.ones((d,)), jnp.zeros((d,))]  # final ln
+    return params
+
+
+def _ln(x, scale, bias, eps=1e-6):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def apply(
+    params: Sequence[jax.Array],
+    tokens: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    attn_fn: Callable | None = None,
+) -> jax.Array:
+    """Logits [B, L, vocab] for int tokens [B, L]; causal."""
+    attn_fn = attn_fn or attention
+    embed, pos = params[0], params[1]
+    B, L = tokens.shape
+    h = embed[tokens] + pos[:L]
+    idx = 2
+    dh = cfg.d_model // cfg.n_heads
+    for _ in range(cfg.n_layers):
+        (ln1_s, ln1_b, wq, wk, wv, wo, ln2_s, ln2_b, w1, b1, w2, b2) = params[
+            idx : idx + PARAMS_PER_LAYER
+        ]
+        idx += PARAMS_PER_LAYER
+        x = _ln(h, ln1_s, ln1_b)
+        q = (x @ wq).reshape(B, L, cfg.n_heads, dh)
+        k = (x @ wk).reshape(B, L, cfg.n_heads, dh)
+        v = (x @ wv).reshape(B, L, cfg.n_heads, dh)
+        a = attn_fn(q, k, v, causal=True).reshape(B, L, cfg.d_model)
+        h = h + a @ wo
+        x = _ln(h, ln2_s, ln2_b)
+        h = h + jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+    h = _ln(h, params[idx], params[idx + 1])
+    return h @ embed.T
+
+
+def loss_and_acc(
+    params: Sequence[jax.Array],
+    X: jax.Array,
+    y: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    attn_fn: Callable | None = None,
+):
+    """Token-level CE (int targets y [B, L]) + accuracy."""
+    logits = apply(params, X, cfg, attn_fn)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, y[..., None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    return loss, acc
+
+
+def make_training_step(
+    cfg: TransformerConfig = TransformerConfig(),
+    attn_fn: Callable | None = None,
+) -> Callable:
+    """Plan-traceable SGD step: (X, y, lr, *params) -> (loss, acc, *new)."""
+
+    def training_step(X, y, lr, *params):
+        (loss, acc), grads = jax.value_and_grad(
+            lambda p: loss_and_acc(p, X, y, cfg, attn_fn), has_aux=True
+        )(list(params))
+        new_params = [p - lr * g for p, g in zip(params, grads)]
+        return (loss, acc, *new_params)
+
+    return training_step
+
+
+#: default-config step so the module satisfies the models.REGISTRY contract
+#: (init/apply/training_step) like mlp and cnn
+training_step = make_training_step()

@@ -9,3 +9,8 @@ from pygrid_tpu.parallel.fedavg import (  # noqa: F401
     make_sharded_round,
     run_rounds,
 )
+from pygrid_tpu.parallel.ring_attention import (  # noqa: F401
+    attention,
+    ring_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,126 @@
+"""Ring / Ulysses attention vs. full attention on the virtual 8-device mesh,
+and the sequence-parallel transformer. (New capability beyond the reference —
+SURVEY.md §5.7 notes the reference has no attention at all.)"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pygrid_tpu.models import transformer
+from pygrid_tpu.parallel import make_mesh
+from pygrid_tpu.parallel.ring_attention import (
+    attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8, axes=("seq",))
+
+
+def _qkv(B=2, L=32, H=8, D=4, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, L, H, D)) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full(mesh, causal):
+    q, k, v = _qkv()
+    ref = attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(mesh, causal):
+    q, k, v = _qkv()
+    ref = attention(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(mesh):
+    q, k, v = _qkv(H=6)
+    with pytest.raises(ValueError):
+        ulysses_attention(q, k, v, mesh)
+
+
+def test_ring_gradients_match_full(mesh):
+    q, k, v = _qkv(B=1, L=16, H=2, D=4)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    g_ref = jax.grad(partial(loss, partial(attention, causal=True)), (0, 1, 2))(
+        q, k, v
+    )
+    g_ring = jax.grad(
+        partial(loss, partial(ring_attention, mesh=mesh, causal=True)),
+        (0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return transformer.TransformerConfig(
+        vocab=31, d_model=32, n_heads=8, n_layers=2, d_ff=64, max_len=64
+    )
+
+
+def test_transformer_param_count(cfg):
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    assert len(params) == (
+        transformer.N_GLOBAL + transformer.PARAMS_PER_LAYER * cfg.n_layers
+    )
+
+
+@pytest.mark.parametrize("sp_attn", ["ring", "ulysses"])
+def test_transformer_sequence_parallel_matches_local(mesh, cfg, sp_attn):
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab)
+    ref = transformer.apply(params, tokens, cfg)
+    fn = ring_attention if sp_attn == "ring" else ulysses_attention
+    out = transformer.apply(
+        params, tokens, cfg, attn_fn=partial(fn, mesh=mesh)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_transformer_training_step_learns(cfg):
+    step = jax.jit(transformer.make_training_step(cfg))
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    X = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    y = jnp.roll(X, -1, axis=1)
+    out = step(X, y, jnp.float32(0.1), *params)
+    first_loss = float(out[0])
+    for _ in range(10):
+        out = step(X, y, jnp.float32(0.1), *out[2:])
+    assert float(out[0]) < first_loss
+
+
+def test_transformer_sequence_parallel_training_step(mesh, cfg):
+    """Full train step (fwd+bwd through ring attention) on the mesh."""
+    step = jax.jit(
+        transformer.make_training_step(
+            cfg, attn_fn=partial(ring_attention, mesh=mesh)
+        )
+    )
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    X = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab)
+    y = jnp.roll(X, -1, axis=1)
+    out = step(X, y, jnp.float32(0.1), *params)
+    ref = jax.jit(transformer.make_training_step(cfg))(
+        X, y, jnp.float32(0.1), *params
+    )
+    np.testing.assert_allclose(float(out[0]), float(ref[0]), atol=1e-5)
+    for a, b in zip(out[2:], ref[2:]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4
+        )
