@@ -1,0 +1,15 @@
+# Grid app image (parity: reference apps/node/Dockerfile — python-slim +
+# app source; entrypoint chosen per-service in docker-compose.yml).
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY pygrid_tpu ./pygrid_tpu
+COPY examples ./examples
+RUN pip install --no-cache-dir .
+
+EXPOSE 5000 7000
+CMD ["python", "-m", "pygrid_tpu.node", "--id", "node", "--port", "5000"]

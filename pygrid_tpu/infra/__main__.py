@@ -1,0 +1,9 @@
+"""``python -m pygrid_tpu.infra`` → the deploy CLI (reference installs it
+as the ``pygrid`` console script, ``apps/infrastructure/cli/setup.py:8-11``).
+The deploy API server is ``python -m pygrid_tpu.infra.api``."""
+
+import sys
+
+from pygrid_tpu.infra.cli import main
+
+sys.exit(main())

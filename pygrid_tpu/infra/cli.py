@@ -1,0 +1,170 @@
+"""``pygrid-tpu`` deploy CLI.
+
+Parity: reference ``apps/infrastructure/cli/cli.py:37-162`` — the
+interactive wizard (provider/app/serverless?/websockets?/app args/db),
+config dump to ``~/.pygrid/cli/config_<ts>.json``, POST to the deploy API.
+Here every prompt is also a flag so the wizard is scriptable
+(``--yes`` skips all prompts); ``--direct`` builds and runs the provider
+in-process instead of POSTing (no API server needed for a dry run)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+from pygrid_tpu.infra.config import (
+    APPS,
+    DEPLOYMENT_TYPES,
+    PROVIDERS,
+    AppConfig,
+    DbConfig,
+    DeployConfig,
+    TpuConfig,
+)
+
+
+def _prompt(text: str, default, interactive: bool, cast=str):
+    if not interactive:
+        return default
+    raw = input(f"{text} [{default}]: ").strip()
+    return cast(raw) if raw else default
+
+
+def _confirm(text: str, default: bool, interactive: bool) -> bool:
+    if not interactive:
+        return default
+    raw = input(f"{text} [{'Y/n' if default else 'y/N'}]: ").strip().lower()
+    if not raw:
+        return default
+    return raw in ("y", "yes")
+
+
+def build_config(args, interactive: bool) -> DeployConfig:
+    app = AppConfig(
+        name=args.app,
+        id=_prompt("Grid app id", args.id or args.app, interactive),
+        host=_prompt("Host", args.host, interactive),
+        port=_prompt("Port", args.port, interactive, int),
+        network=args.network
+        if not interactive or args.app != "node"
+        else (_prompt("Grid Network address", args.network or "", interactive) or None),
+        num_replicas=args.num_replicas,
+    )
+    tpu = TpuConfig(
+        accelerator_type=_prompt(
+            "TPU accelerator type", args.accelerator_type, interactive
+        ),
+        zone=_prompt("GCP zone", args.zone, interactive),
+        project=_prompt("GCP project", args.project, interactive),
+        num_hosts=args.num_hosts,
+        preemptible=args.preemptible,
+    )
+    deployment_type = (
+        "serverless"
+        if _confirm(
+            "Do you want to deploy serverless?",
+            args.deployment_type == "serverless",
+            interactive,
+        )
+        else "serverfull"
+    )
+    websockets = _confirm(
+        "Will you need to support Websockets?", True, interactive
+    )
+    credentials = {}
+    if args.credentials:
+        with open(args.credentials) as f:
+            credentials = json.load(f)
+    return DeployConfig(
+        provider=args.provider,
+        deployment_type=deployment_type,
+        websockets=websockets,
+        app=app,
+        tpu=tpu,
+        db=DbConfig(url=args.database_url),
+        credentials=credentials,
+        root_dir=args.root_dir,
+    )
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pygrid-tpu",
+        description="pygrid-tpu infrastructure CLI  (e.g. "
+        "`pygrid-tpu deploy --provider gcp --app node`)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    d = sub.add_parser("deploy", help="deploy a grid app")
+    d.add_argument("--provider", choices=PROVIDERS, default="gcp")
+    d.add_argument("--app", choices=APPS, default="node")
+    d.add_argument("--deployment-type", choices=DEPLOYMENT_TYPES,
+                   default="serverfull")
+    d.add_argument("--id", default=None)
+    d.add_argument("--host", default="0.0.0.0")
+    d.add_argument("--port", type=int, default=5000)
+    d.add_argument("--network", default=None)
+    d.add_argument("--num_replicas", type=int, default=1)
+    d.add_argument("--accelerator-type", default="v5litepod-8")
+    d.add_argument("--zone", default="us-central1-a")
+    d.add_argument("--project", default="pygrid-tpu")
+    d.add_argument("--num-hosts", type=int, default=1)
+    d.add_argument("--preemptible", action="store_true")
+    d.add_argument("--database-url", default="grid.db")
+    d.add_argument("--credentials", default=None,
+                   help="path to provider credentials json")
+    d.add_argument("--root-dir", default=None,
+                   help="artifact dir (default ./.pygrid_tpu)")
+    d.add_argument("--api-url", default="http://localhost:5005/")
+    d.add_argument("--direct", action="store_true",
+                   help="run the provider in-process (no deploy API)")
+    d.add_argument("--apply", action="store_true",
+                   help="actually apply (terraform/spawn); default dry run")
+    d.add_argument("--yes", "-y", action="store_true",
+                   help="non-interactive: accept defaults/flags")
+    d.add_argument("--output-file", default=None)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    interactive = not args.yes and sys.stdin.isatty()
+    config = build_config(args, interactive)
+
+    # config dump (reference cli.py:157-162)
+    root = Path(config.root_dir or os.getcwd()) / ".pygrid_tpu" / "cli"
+    root.mkdir(parents=True, exist_ok=True)
+    out = args.output_file or str(
+        root / f"config_{time.strftime('%Y-%m-%d_%H%M%S')}.json"
+    )
+    payload = config.to_dict()
+    payload["apply"] = args.apply
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+    print(f"Wrote config to {out}")
+
+    if args.direct:
+        from pygrid_tpu.infra import handle_deploy
+
+        result = handle_deploy(payload)
+        print(json.dumps(result, indent=2))
+        return 0
+
+    import requests
+
+    r = requests.post(args.api_url, json=payload, timeout=600)
+    if r.status_code == 200:
+        print(f"Your grid {config.app.name} was deployed successfully")
+        return 0
+    print(
+        f"There was an issue deploying your grid {config.app.name}: "
+        f"{r.status_code} {r.text}"
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Deployment configuration model.
+
+Parity: the reference builds an ad-hoc ``Config`` attribute bag in the CLI
+(``apps/infrastructure/cli/utils.py``, filled by ``cli.py:53-113``) and the
+API re-reads it as nested dicts (``api/__main__.py:17-28``). Here the shape
+is explicit dataclasses with the same field names (provider,
+deployment_type, websockets, app{name,id,host,port,network}, credentials)
+plus the TPU-specific block the reference's AWS ``vpc`` section becomes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+PROVIDERS = ("gcp", "local", "aws", "azure")
+APPS = ("node", "network", "worker")
+DEPLOYMENT_TYPES = ("serverfull", "serverless")
+
+
+@dataclass
+class AppConfig:
+    """The grid app being deployed (reference cli.py:115-154)."""
+
+    name: str = "node"
+    id: str | None = None
+    host: str = "0.0.0.0"
+    port: int = 5000
+    network: str | None = None
+    num_replicas: int = 1
+
+    def __post_init__(self) -> None:
+        if self.name not in APPS:
+            raise ValueError(f"unknown app {self.name!r}; expected {APPS}")
+        if self.name == "node" and self.id is None:
+            self.id = "node"
+
+
+@dataclass
+class TpuConfig:
+    """The accelerator block — what the reference's AWS ``vpc`` prompt
+    (``cli/provider_utils/aws.py``) becomes on TPU: slice shape instead of
+    subnet shape."""
+
+    accelerator_type: str = "v5litepod-8"
+    runtime_version: str = "v2-alpha-tpuv5-lite"
+    zone: str = "us-central1-a"
+    project: str = "pygrid-tpu"
+    #: hosts in the slice; >1 ⇒ jax.distributed DCN mesh across workers
+    num_hosts: int = 1
+    preemptible: bool = False
+
+
+@dataclass
+class DbConfig:
+    """Database prompt (reference ``aws.get_db_config`` — username/password
+    for Aurora). Here: a sqlite path or cloud-sql instance name."""
+
+    engine: str = "sqlite"
+    url: str = "grid.db"
+    username: str | None = None
+    password: str | None = None
+
+
+@dataclass
+class DeployConfig:
+    provider: str = "gcp"
+    deployment_type: str = "serverfull"
+    websockets: bool = True
+    app: AppConfig = field(default_factory=AppConfig)
+    tpu: TpuConfig = field(default_factory=TpuConfig)
+    db: DbConfig = field(default_factory=DbConfig)
+    #: opaque provider credentials (reference: parsed credentials.json)
+    credentials: dict[str, Any] = field(default_factory=dict)
+    root_dir: str | None = None
+
+    def __post_init__(self) -> None:
+        self.provider = self.provider.lower()
+        self.deployment_type = self.deployment_type.lower()
+        if self.provider not in PROVIDERS:
+            raise ValueError(
+                f"unknown provider {self.provider!r}; expected {PROVIDERS}"
+            )
+        if self.deployment_type not in DEPLOYMENT_TYPES:
+            raise ValueError(
+                f"unknown deployment_type {self.deployment_type!r}"
+            )
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "DeployConfig":
+        data = dict(data)
+        app = data.pop("app", {})
+        tpu = data.pop("tpu", {})
+        db = data.pop("db", {})
+        known = {k: v for k, v in data.items() if k in cls.__dataclass_fields__}
+        return cls(
+            app=AppConfig(**app) if isinstance(app, dict) else app,
+            tpu=TpuConfig(**tpu) if isinstance(tpu, dict) else tpu,
+            db=DbConfig(**db) if isinstance(db, dict) else db,
+            **known,
+        )
+
+    def to_dict(self) -> dict:
+        return asdict(self)

@@ -1,0 +1,32 @@
+"""Provider registry.
+
+Parity: the dispatch table in reference ``api/__main__.py:22-35``
+(provider × deployment_type → builder class; azure/gcp were empty stubs
+there — here GCP is the first-class target and AWS/Azure raise clearly)."""
+
+from __future__ import annotations
+
+from pygrid_tpu.infra.config import DeployConfig
+from pygrid_tpu.infra.providers.base import Provider, server_command
+from pygrid_tpu.infra.providers.gcp import GCPServerfull, GCPServerless
+from pygrid_tpu.infra.providers.local import LocalProvider
+
+__all__ = ["build_provider", "Provider", "server_command"]
+
+_REGISTRY = {
+    ("gcp", "serverfull"): GCPServerfull,
+    ("gcp", "serverless"): GCPServerless,
+    ("local", "serverfull"): LocalProvider,
+    ("local", "serverless"): LocalProvider,
+}
+
+
+def build_provider(config: DeployConfig) -> Provider:
+    key = (config.provider, config.deployment_type)
+    if key not in _REGISTRY:
+        raise NotImplementedError(
+            f"provider {config.provider!r} ({config.deployment_type}) is not "
+            "implemented; available: "
+            + ", ".join("/".join(k) for k in sorted(_REGISTRY))
+        )
+    return _REGISTRY[key](config)

@@ -1,0 +1,108 @@
+"""Provider base: render artifacts → (optionally) terraform apply.
+
+Parity: reference ``api/providers/provider.py:16-30`` — builds a
+terrascript document, dumps ``main.tf.json`` into ``~/.pygrid/api`` and
+shells out to terraform. Here artifacts are plain dicts (terraform JSON
+needs no terrascript), the root dir is configurable (never defaults outside
+the working tree), and ``deploy(apply=False)`` is a dry run returning the
+rendered files."""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from pygrid_tpu.infra.config import DeployConfig
+from pygrid_tpu.infra.tf import Terraform
+
+
+class Provider:
+    name = "base"
+
+    def __init__(self, config: DeployConfig) -> None:
+        self.config = config
+        root = config.root_dir or os.environ.get(
+            "PYGRID_TPU_HOME", os.getcwd()
+        )
+        # everything lives under <root>/.pygrid_tpu — same home the CLI
+        # writes its config dumps to (cli.py), one layout for operators
+        self.root_dir = str(Path(root) / ".pygrid_tpu" / "api" / self.name)
+        self.tf = Terraform()
+
+    def render(self) -> dict[str, str]:
+        """filename → file contents (terraform JSON, manifests, scripts)."""
+        raise NotImplementedError
+
+    def deploy(self, apply: bool = False) -> dict:
+        os.makedirs(self.root_dir, exist_ok=True)
+        files = self.render()
+        for fname, contents in files.items():
+            with open(os.path.join(self.root_dir, fname), "w") as f:
+                f.write(contents)
+        applied = False
+        if apply and self.tf.available() and "main.tf.json" in files:
+            self.tf.init(self.root_dir)
+            applied = self.tf.apply(self.root_dir) == 0
+        return {
+            "root_dir": self.root_dir,
+            "files": sorted(files),
+            "applied": applied,
+        }
+
+    def destroy(self) -> bool:
+        if self.tf.available():
+            return self.tf.destroy(self.root_dir) == 0
+        return False
+
+    @staticmethod
+    def _json(doc: dict) -> str:
+        return json.dumps(doc, indent=2, sort_keys=False)
+
+
+def shell_line(argv: list[str]) -> str:
+    import shlex
+
+    return " ".join(shlex.quote(a) for a in argv)
+
+
+def server_command(config: DeployConfig) -> list[str]:
+    """The grid server argv for this app — shared by every provider's
+    startup script (the analog of reference ``apps/node/entrypoint.sh``)."""
+    app = config.app
+    if app.name == "node":
+        cmd = [
+            "python",
+            "-m",
+            "pygrid_tpu.node",
+            "--id",
+            str(app.id),
+            "--host",
+            app.host,
+            "--port",
+            str(app.port),
+        ]
+        if app.network:
+            cmd += ["--network", app.network]
+        if app.num_replicas and app.num_replicas > 1:
+            cmd += ["--num_replicas", str(app.num_replicas)]
+        return cmd
+    if app.name == "network":
+        return [
+            "python",
+            "-m",
+            "pygrid_tpu.network",
+            "--host",
+            app.host,
+            "--port",
+            str(app.port),
+        ]
+    # worker: ephemeral compute joining a node (reference apps/worker is a
+    # stub; ours runs the simulation engine against a node address)
+    return [
+        "python",
+        "-m",
+        "pygrid_tpu.worker",
+        "--node",
+        app.network or f"http://127.0.0.1:{app.port}",
+    ]

@@ -1,0 +1,157 @@
+"""GCP TPU providers.
+
+Parity: reference ``api/providers/aws/serverless.py:26-351`` builds the
+whole AWS stack (S3 + Lambda layer + API Gateway + EFS) as terrascript;
+``serverfull.py:22-23`` is a stub; ``deploy/serverless-node/*.tf`` is the
+hand-written equivalent. The TPU-native translation:
+
+- **serverfull** → a ``google_tpu_v2_vm`` slice per grid app. The startup
+  script launches the node/network server on the TPU host; multi-host
+  slices launch one process per worker and form the DCN mesh via
+  ``jax.distributed`` (coordinator = worker 0).
+- **serverless** → Cloud Run for the coordination plane (it is pure
+  asyncio/SQL, the analog of the reference's Lambda'd Flask app) plus a
+  ``google_tpu_v2_queued_resource`` the node acquires for burst compute —
+  TPUs have no lambda; queued resources are the elastic form.
+"""
+
+from __future__ import annotations
+
+import shlex
+
+from pygrid_tpu.infra.config import DeployConfig
+from pygrid_tpu.infra.providers.base import Provider, server_command, shell_line
+
+
+def _startup_script(config: DeployConfig) -> str:
+    cmd = shell_line(server_command(config))
+    lines = [
+        "#!/bin/bash",
+        "set -e",
+        "pip install pygrid-tpu",
+        f"export DATABASE_URL={shlex.quote(config.db.url)}",
+    ]
+    if config.tpu.num_hosts > 1:
+        # one server process per TPU worker; jax.distributed picks up the
+        # coordinator from the TPU metadata (worker 0)
+        lines.append("export PYGRID_TPU_MULTIHOST=1")
+    lines.append(f"exec {cmd}")
+    return "\n".join(lines) + "\n"
+
+
+class GCPServerfull(Provider):
+    """TPU VM deployment — the workhorse path."""
+
+    name = "gcp-serverfull"
+
+    def render(self) -> dict[str, str]:
+        cfg, tpu, app = self.config, self.config.tpu, self.config.app
+        vm_name = f"pygrid-{app.name}-{app.id or app.name}"
+        doc = {
+            "terraform": {
+                "required_providers": {
+                    "google": {"source": "hashicorp/google"}
+                }
+            },
+            "provider": {
+                "google": {"project": tpu.project, "zone": tpu.zone}
+            },
+            "resource": {
+                "google_tpu_v2_vm": {
+                    "grid_app": {
+                        "name": vm_name,
+                        "zone": tpu.zone,
+                        "accelerator_type": tpu.accelerator_type,
+                        "runtime_version": tpu.runtime_version,
+                        "scheduling_config": {
+                            "preemptible": tpu.preemptible
+                        },
+                        "metadata": {
+                            "startup-script": _startup_script(cfg)
+                        },
+                    }
+                },
+                "google_compute_firewall": {
+                    "grid_ingress": {
+                        "name": f"{vm_name}-ingress",
+                        "network": "default",
+                        "allow": [
+                            {"protocol": "tcp", "ports": [str(app.port)]}
+                        ],
+                        "source_ranges": ["0.0.0.0/0"],
+                    }
+                },
+            },
+            "output": {
+                "endpoint": {
+                    "value": "${google_tpu_v2_vm.grid_app.network_endpoints}"
+                }
+            },
+        }
+        return {
+            "main.tf.json": self._json(doc),
+            "startup.sh": _startup_script(cfg),
+        }
+
+
+class GCPServerless(Provider):
+    """Cloud Run coordination plane + queued TPU resource for compute."""
+
+    name = "gcp-serverless"
+
+    def render(self) -> dict[str, str]:
+        cfg, tpu, app = self.config, self.config.tpu, self.config.app
+        svc_name = f"pygrid-{app.name}"
+        doc = {
+            "terraform": {
+                "required_providers": {
+                    "google": {"source": "hashicorp/google"}
+                }
+            },
+            "provider": {
+                "google": {"project": tpu.project, "zone": tpu.zone}
+            },
+            "resource": {
+                "google_cloud_run_v2_service": {
+                    "grid_app": {
+                        "name": svc_name,
+                        "location": tpu.zone.rsplit("-", 1)[0],
+                        "template": {
+                            "containers": [
+                                {
+                                    "image": "pygrid-tpu/grid:latest",
+                                    "args": server_command(cfg)[1:],
+                                    "ports": [
+                                        {"container_port": app.port}
+                                    ],
+                                    "env": [
+                                        {
+                                            "name": "DATABASE_URL",
+                                            "value": cfg.db.url,
+                                        }
+                                    ],
+                                }
+                            ]
+                        },
+                    }
+                },
+                "google_tpu_v2_queued_resource": {
+                    "grid_compute": {
+                        "name": f"{svc_name}-compute",
+                        "zone": tpu.zone,
+                        "tpu": {
+                            "node_spec": [
+                                {
+                                    "node_id": f"{svc_name}-tpu",
+                                    "node": {
+                                        "accelerator_type": tpu.accelerator_type,
+                                        "runtime_version": tpu.runtime_version,
+                                    },
+                                }
+                            ]
+                        },
+                    }
+                },
+            },
+        }
+        return {"main.tf.json": self._json(doc)}

@@ -1,0 +1,51 @@
+"""Local provider — the development "cloud": real grid processes.
+
+No reference analog (its providers only target clouds; local dev is
+``docker-compose.yml``). Renders a compose-style process table and, on
+``deploy(apply=True)``, actually spawns the servers with ``subprocess`` —
+the programmatic twin of the reference's compose file (1 network + N
+nodes, ``docker-compose.yml:3-76``)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+from pygrid_tpu.infra.providers.base import Provider, server_command, shell_line
+
+
+class LocalProvider(Provider):
+    name = "local"
+
+    def __init__(self, config) -> None:
+        super().__init__(config)
+        self.processes: list[subprocess.Popen] = []
+
+    def command(self) -> list[str]:
+        cmd = server_command(self.config)
+        return [sys.executable, *cmd[1:]] if cmd[0] == "python" else cmd
+
+    def render(self) -> dict[str, str]:
+        return {
+            "run.sh": "#!/bin/bash\nexec " + shell_line(self.command()) + "\n",
+        }
+
+    def deploy(self, apply: bool = False) -> dict:
+        result = super().deploy(apply=False)
+        if apply:
+            proc = subprocess.Popen(self.command())
+            self.processes.append(proc)
+            result["pid"] = proc.pid
+            result["applied"] = True
+        return result
+
+    def destroy(self) -> bool:
+        for proc in self.processes:
+            proc.terminate()
+        for proc in self.processes:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.processes.clear()
+        return True

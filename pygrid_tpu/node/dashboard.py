@@ -1,0 +1,57 @@
+"""Node web dashboard.
+
+Parity surface: reference ``apps/node/src/app/{templates/index.html,
+static/js/main.js}`` — a landing page that fetches
+``/data-centric/detailed-models-list/`` and renders the hosted models.
+Here it is one self-contained page (no static asset tree) that also shows
+node identity/status, so a browser hitting the node root sees the grid
+state."""
+
+from __future__ import annotations
+
+PAGE = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>pygrid-tpu node — {node_id}</title>
+<style>
+  body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 52rem;
+         color: #1a1a1a; }}
+  h1 {{ font-size: 1.4rem; }} code {{ background: #f4f4f4; padding: .1em .3em; }}
+  table {{ border-collapse: collapse; width: 100%; margin-top: 1rem; }}
+  th, td {{ text-align: left; padding: .4rem .6rem; border-bottom: 1px solid #ddd; }}
+  .muted {{ color: #777; }}
+</style>
+</head>
+<body>
+<h1>pygrid-tpu node <code>{node_id}</code></h1>
+<p class="muted" id="status">loading status…</p>
+<h2>Hosted models</h2>
+<table id="models"><thead>
+<tr><th>id</th><th>download</th><th>remote inference</th><th>mpc</th></tr>
+</thead><tbody></tbody></table>
+<script>
+async function refresh() {{
+  try {{
+    const st = await (await fetch('/data-centric/status/')).json();
+    document.getElementById('status').textContent =
+      'status: ' + (st.status || JSON.stringify(st));
+    const res = await (await fetch('/data-centric/detailed-models-list/')).json();
+    const rows = (res.models || []).map(m =>
+      `<tr><td>${{m.id}}</td><td>${{m.allow_download}}</td>` +
+      `<td>${{m.allow_remote_inference}}</td><td>${{m.mpc}}</td></tr>`);
+    document.querySelector('#models tbody').innerHTML =
+      rows.join('') || '<tr><td colspan=4 class=muted>none</td></tr>';
+  }} catch (err) {{
+    document.getElementById('status').textContent = 'error: ' + err;
+  }}
+}}
+refresh(); setInterval(refresh, 5000);
+</script>
+</body>
+</html>
+"""
+
+
+def render(node_id: str) -> str:
+    return PAGE.format(node_id=node_id)

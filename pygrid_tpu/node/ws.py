@@ -22,7 +22,14 @@ async def ws_handler(request: web.Request) -> web.StreamResponse:
     ctx = request.app["node"]
     if (
         request.headers.get("Upgrade", "").lower() != "websocket"
-    ):  # plain GET / → landing info (reference serves the dashboard here)
+    ):  # plain GET / → dashboard for browsers, JSON for programs
+        # (reference serves templates/index.html here, app/__init__.py:173)
+        if "text/html" in request.headers.get("Accept", ""):
+            from pygrid_tpu.node.dashboard import render
+
+            return web.Response(
+                text=render(ctx.id), content_type="text/html"
+            )
         return web.json_response(
             {"node_id": ctx.id, "message": "pygrid-tpu node"}
         )

@@ -1,0 +1,96 @@
+"""Worker app — ephemeral FL compute.
+
+Parity surface: reference ``apps/worker/src/__init__.py:1`` is an **empty
+stub** (version string only; the real edge executor is syft.js / PySyft's
+FLClient on devices). Here the worker is functional: it drives the full
+cycle protocol (SURVEY.md §3.3) with the framework's own ``FLClient`` and
+executes the downloaded training Plan locally — on TPU when one is
+attached, so a single worker process can stand in for thousands of edge
+devices by batching its local steps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+__version__ = "0.1.0"
+
+
+@dataclass
+class WorkerResult:
+    accepted: int = 0
+    rejected: int = 0
+    errors: list[str] = field(default_factory=list)
+
+
+def default_data_fn(batch_size: int, features: int = 784, classes: int = 10):
+    """Synthetic MNIST-shaped batch (the reference worker has no data of its
+    own; real deployments pass a ``data_fn`` reading local storage)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(batch_size, features)).astype("float32")
+    y = np.eye(classes, dtype="float32")[
+        rng.integers(0, classes, size=batch_size)
+    ]
+    return X, y
+
+
+def run_worker(
+    node_url: str,
+    model_name: str,
+    model_version: str | None = None,
+    auth_token: str | None = None,
+    data_fn: Callable[[int], tuple] = default_data_fn,
+    cycles: int = 1,
+    max_retry_wait: float = 30.0,
+) -> WorkerResult:
+    """Participate in up to ``cycles`` FL cycles: authenticate → cycle
+    request → download model+plan → local plan execution → report diff.
+    A *rejected* cycle carries a retry window the node expects the worker
+    to honor (reference fl_controller.py:160-172) — we sleep it (capped at
+    ``max_retry_wait``) before the next request."""
+    import time
+
+    from pygrid_tpu.client.fl_client import FLClient
+
+    result = WorkerResult()
+    client = FLClient(node_url, auth_token=auth_token)
+    try:
+        for _ in range(cycles):
+            retry_wait = [0.0]
+            job = client.new_job(model_name, model_version)
+
+            def on_accepted(job: Any) -> None:
+                plan = job.plans["training_plan"]
+                params = job.model_params
+                cfg = job.client_config or {}
+                batch_size = int(cfg.get("batch_size", 64))
+                lr = float(cfg.get("lr", 0.1))
+                X, y = data_fn(batch_size)
+                out = plan(X, y, lr, *params)
+                # plan returns (metrics..., *new_params); the param tail is
+                # positionally last (reference plan convention, nb 01 cell 16)
+                new_params = list(out[-len(params):])
+                diff = [p - n for p, n in zip(params, new_params)]
+                job.report(diff)
+                result.accepted += 1
+
+            def on_rejected(job: Any, timeout: Any) -> None:
+                result.rejected += 1
+                if timeout:
+                    retry_wait[0] = min(float(timeout), max_retry_wait)
+
+            def on_error(job: Any, err: Exception) -> None:
+                result.errors.append(str(err))
+
+            job.add_listener(job.EVENT_ACCEPTED, on_accepted)
+            job.add_listener(job.EVENT_REJECTED, on_rejected)
+            job.add_listener(job.EVENT_ERROR, on_error)
+            job.start()
+            if retry_wait[0]:
+                time.sleep(retry_wait[0])
+    finally:
+        client.close()
+    return result

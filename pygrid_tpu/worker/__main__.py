@@ -1,0 +1,38 @@
+"""``python -m pygrid_tpu.worker`` — join a node and train.
+
+The reference's worker app has no entrypoint (empty stub); this is the
+CLI the compose file and the local infra provider launch."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="pygrid-tpu FL worker")
+    parser.add_argument("--node", required=True, help="node URL")
+    parser.add_argument("--model-name", default="mnist")
+    parser.add_argument("--model-version", default=None)
+    parser.add_argument("--auth-token", default=None)
+    parser.add_argument("--cycles", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    from pygrid_tpu.worker import run_worker
+
+    result = run_worker(
+        args.node,
+        args.model_name,
+        model_version=args.model_version,
+        auth_token=args.auth_token,
+        cycles=args.cycles,
+    )
+    print(
+        f"worker done: accepted={result.accepted} rejected={result.rejected} "
+        f"errors={result.errors}"
+    )
+    return 0 if not result.errors else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

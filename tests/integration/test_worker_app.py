@@ -1,0 +1,83 @@
+"""Worker app end-to-end: the ephemeral compute client completes a cycle.
+
+The reference's worker app is an empty stub (apps/worker/src/__init__.py:1);
+here it is a functional FL participant, so the test drives the real
+protocol: host a process on a node → ``run_worker`` authenticates, gets the
+cycle, trains locally via the downloaded Plan, reports a diff the node
+aggregates into checkpoint 2."""
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from pygrid_tpu.client import ModelCentricFLClient
+from pygrid_tpu.federated.auth import jwt_encode
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.worker import run_worker
+
+SECRET = "worker-secret"
+NAME, VERSION = "worker-mnist", "1.0"
+D, H, C, B = 784, 16, 10, 8
+
+
+@pytest.fixture(scope="module")
+def hosted(grid):
+    params = mlp.init(jax.random.PRNGKey(3), (D, H, C))
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *[np.asarray(p) for p in params],
+    )
+    client = ModelCentricFLClient(grid.node_url("bob"))
+    response = client.host_federated_training(
+        model=[np.asarray(p) for p in params],
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": NAME,
+            "version": VERSION,
+            "batch_size": B,
+            "lr": 0.1,
+            "max_updates": 1,
+        },
+        server_config={
+            "min_workers": 1,
+            "max_workers": 4,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+            "cycle_length": 28800,
+            "num_cycles": 2,
+            "max_diffs": 1,
+            "min_diffs": 1,
+            "authentication": {"secret": SECRET},
+        },
+    )
+    assert response.get("status") == "success"
+    client.close()
+
+
+def test_run_worker_completes_cycle(grid, hosted):
+    token = jwt_encode({}, SECRET)
+    result = run_worker(
+        grid.node_url("bob"), NAME, VERSION, auth_token=token, cycles=1
+    )
+    assert result.errors == []
+    assert result.accepted == 1
+
+
+def test_dashboard_served_to_browsers(grid):
+    resp = requests.get(
+        grid.node_url("bob") + "/",
+        headers={"Accept": "text/html,application/xhtml+xml"},
+        timeout=10,
+    )
+    assert resp.status_code == 200
+    assert "text/html" in resp.headers["Content-Type"]
+    assert "pygrid-tpu node" in resp.text and "bob" in resp.text
+    # programs still get JSON
+    resp = requests.get(grid.node_url("bob") + "/", timeout=10)
+    assert resp.json()["node_id"] == "bob"

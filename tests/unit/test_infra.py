@@ -1,0 +1,98 @@
+"""Infrastructure plane tests.
+
+Mirrors the reference's test style for the API apps (direct handler calls +
+artifact inspection); the reference ships no tests for apps/infrastructure,
+so coverage here is new."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pygrid_tpu.infra import handle_deploy
+from pygrid_tpu.infra.cli import main as cli_main
+from pygrid_tpu.infra.config import AppConfig, DeployConfig, TpuConfig
+from pygrid_tpu.infra.providers import build_provider, server_command
+from pygrid_tpu.infra.providers.local import LocalProvider
+
+
+def _node_config(tmp_path, **kw) -> DeployConfig:
+    return DeployConfig(
+        app=AppConfig(name="node", id="alice", port=5001,
+                      network="http://net:7000"),
+        root_dir=str(tmp_path),
+        **kw,
+    )
+
+
+def test_server_command_node(tmp_path):
+    cmd = server_command(_node_config(tmp_path))
+    assert "pygrid_tpu.node" in cmd
+    assert ["--id", "alice"] == cmd[cmd.index("--id"):cmd.index("--id") + 2]
+    assert "--network" in cmd
+
+
+def test_gcp_serverfull_renders_tpu_vm(tmp_path):
+    provider = build_provider(_node_config(tmp_path))
+    artifacts = provider.deploy(apply=False)
+    assert artifacts["applied"] is False
+    main_tf = json.load(open(f"{artifacts['root_dir']}/main.tf.json"))
+    vm = main_tf["resource"]["google_tpu_v2_vm"]["grid_app"]
+    assert vm["accelerator_type"] == "v5litepod-8"
+    assert "pygrid_tpu.node" in vm["metadata"]["startup-script"]
+    fw = main_tf["resource"]["google_compute_firewall"]["grid_ingress"]
+    assert {"protocol": "tcp", "ports": ["5001"]} in fw["allow"]
+
+
+def test_gcp_serverless_renders_cloud_run(tmp_path):
+    cfg = _node_config(tmp_path, deployment_type="serverless")
+    artifacts = build_provider(cfg).deploy()
+    main_tf = json.load(open(f"{artifacts['root_dir']}/main.tf.json"))
+    assert "google_cloud_run_v2_service" in main_tf["resource"]
+    assert "google_tpu_v2_queued_resource" in main_tf["resource"]
+
+
+def test_multihost_startup_sets_distributed_env(tmp_path):
+    cfg = _node_config(tmp_path)
+    cfg.tpu = TpuConfig(num_hosts=4)
+    files = build_provider(cfg).render()
+    assert "PYGRID_TPU_MULTIHOST=1" in files["startup.sh"]
+
+
+def test_local_provider_dry_run(tmp_path):
+    cfg = _node_config(tmp_path, provider="local")
+    provider = build_provider(cfg)
+    assert isinstance(provider, LocalProvider)
+    result = provider.deploy(apply=False)
+    assert result["applied"] is False and "run.sh" in result["files"]
+
+
+def test_unknown_provider_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        DeployConfig(provider="ibm")
+    with pytest.raises(NotImplementedError):
+        build_provider(_node_config(tmp_path, provider="aws"))
+
+
+def test_handle_deploy_roundtrip(tmp_path):
+    """The deploy API core: CLI config dict → artifacts on disk (reference
+    api/__main__.py:17-40 contract)."""
+    payload = _node_config(tmp_path).to_dict()
+    result = handle_deploy(payload)
+    assert result["message"] == "Deployment successful"
+    assert result["provider"] == "gcp"
+    assert "main.tf.json" in result["artifacts"]["files"]
+
+
+def test_cli_direct_dry_run(tmp_path, capsys):
+    rc = cli_main([
+        "deploy", "--yes", "--direct", "--provider", "gcp", "--app",
+        "network", "--port", "7000", "--root-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Deployment successful" in out
+    configs = list((tmp_path / ".pygrid_tpu" / "cli").glob("config_*.json"))
+    assert len(configs) == 1
+    assert json.load(open(configs[0]))["app"]["name"] == "network"
