@@ -1,0 +1,57 @@
+"""Populate a node with private tagged tensors.
+
+Mirror of reference
+``examples/data-centric/mnist/01-FL-mnist-populate-a-grid-node.ipynb``:
+login to a node, ``send`` dataset shards with #tags and descriptions so
+data scientists can discover them via grid search."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from _grid import example_args, spawn_grid, wait_for
+
+
+def main() -> int:
+    parser = example_args("populate a node with tagged data")
+    parser.add_argument("--shards", type=int, default=4)
+    args = parser.parse_args()
+    node_url = args.node
+    if args.spawn:
+        _, nodes = spawn_grid(1)
+        node_url = nodes["alice"]
+    wait_for(node_url, args.wait)
+
+    from pygrid_tpu.client import DataCentricFLClient
+
+    client = DataCentricFLClient(node_url)
+    client.login("admin", "admin")
+
+    rng = np.random.default_rng(0)
+    for shard in range(args.shards):
+        X = rng.normal(size=(64, 784)).astype("float32")
+        y = rng.integers(0, 10, size=(64,)).astype("int32")
+        client.send(
+            X,
+            tags={"#X", "#mnist", f"#shard-{shard}"},
+            description=f"MNIST images shard {shard}",
+        )
+        client.send(
+            y,
+            tags={"#Y", "#mnist", f"#shard-{shard}"},
+            description=f"MNIST labels shard {shard}",
+        )
+    found = client.search("#mnist")
+    print(f"sent {2 * args.shards} tensors to {node_url}; "
+          f"search('#mnist') → {len(found)} pointers")
+    client.close()
+    return 0 if len(found) == 2 * args.shards else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

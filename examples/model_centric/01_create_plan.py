@@ -1,0 +1,78 @@
+"""Host a model-centric FL process: trace plans, define configs, host.
+
+Mirror of reference ``examples/model-centric/01-Create-plan.ipynb``: build
+the MNIST MLP (cell 10), trace the training plan (cells 16-24, there via
+``PySyft func2plan(trace_autograd=True)``, here via ``jax.make_jaxpr``
+inside ``Plan.build``), define client/server configs (cell 33), and host
+everything on a node (cell 39)."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from _grid import example_args, spawn_grid, wait_for
+
+NAME, VERSION = "mnist", "1.0"
+D, H, C, B = 784, 392, 10, 64
+
+
+def main() -> int:
+    args = example_args("host an FL process").parse_args()
+    node_url = args.node
+    if args.spawn:
+        _, nodes = spawn_grid(1)
+        node_url = nodes["alice"]
+    wait_for(node_url, args.wait)
+
+    import jax
+
+    from pygrid_tpu.client import ModelCentricFLClient
+    from pygrid_tpu.models import mlp
+    from pygrid_tpu.plans.plan import Plan
+
+    params = mlp.init(jax.random.PRNGKey(42), (D, H, C))
+    training_plan = Plan(name="training_plan", fn=mlp.training_step)
+    training_plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.005),
+        *[np.asarray(p) for p in params],
+    )
+
+    client = ModelCentricFLClient(node_url)
+    response = client.host_federated_training(
+        model=[np.asarray(p) for p in params],
+        client_plans={"training_plan": training_plan},
+        client_config={
+            "name": NAME,
+            "version": VERSION,
+            "batch_size": B,
+            "lr": 0.005,
+            "max_updates": 100,
+        },
+        server_config={
+            "min_workers": 2,
+            "max_workers": 4,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 6,
+            "cycle_length": 28800,
+            "num_cycles": 5,
+            "max_diffs": 2,
+            "min_diffs": 2,
+            "minimum_upload_speed": 0,
+            "minimum_download_speed": 0,
+            "iterative_plan": True,
+        },
+    )
+    client.close()
+    print(f"hosted {NAME}/{VERSION} on {node_url}: {response}")
+    return 0 if response.get("status") == "success" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+"""Run FL workers against a hosted process until the cycles complete.
+
+Mirror of reference ``examples/model-centric/02-ExecutePlan.ipynb`` (cells
+7-15): N workers authenticate, request a cycle, download model + plan, run
+local SGD via the plan, and report diffs; the node FedAvg-aggregates and
+advances cycles. Checkpoint retrieval at the end mirrors
+``/model-centric/retrieve-model`` (reference routes.py:471-516)."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from _grid import example_args, wait_for
+
+NAME, VERSION = "mnist", "1.0"
+
+
+def main() -> int:
+    parser = example_args("execute FL training cycles")
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--cycles", type=int, default=2)
+    args = parser.parse_args()
+    wait_for(args.node, args.wait)
+
+    from pygrid_tpu.client import ModelCentricFLClient
+    from pygrid_tpu.worker import run_worker
+
+    total_accepted = 0
+    for cycle in range(args.cycles):
+        for w in range(args.workers):
+            result = run_worker(args.node, NAME, VERSION, cycles=1)
+            total_accepted += result.accepted
+            print(
+                f"cycle {cycle} worker {w}: accepted={result.accepted} "
+                f"rejected={result.rejected} errors={result.errors}"
+            )
+
+    client = ModelCentricFLClient(args.node)
+    try:
+        checkpoint = client.retrieve_model(NAME, VERSION, "latest")
+        print(f"latest checkpoint: {len(checkpoint)} tensors, "
+              f"first shape {checkpoint[0].shape}")
+    finally:
+        client.close()
+    return 0 if total_accepted else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
