@@ -99,8 +99,19 @@ def ring_attention(
         def accumulate(t, k_blk, v_blk, o, l, m):
             kv_idx = (my - t) % p_sz
             k_pos = kv_idx * Lk + jnp.arange(Lk)
-            return _block_accumulate(
-                q, k_blk, v_blk, o, l, m, scale_, q_pos, k_pos, causal
+            if not causal:
+                return _block_accumulate(
+                    q, k_blk, v_blk, o, l, m, scale_, q_pos, k_pos, causal
+                )
+            # fully-masked blocks (kv block strictly after the q block)
+            # contribute nothing — skip their einsum/exp work entirely;
+            # the conditional HLO runs only the taken branch per device
+            return lax.cond(
+                kv_idx <= my,
+                lambda: _block_accumulate(
+                    q, k_blk, v_blk, o, l, m, scale_, q_pos, k_pos, causal
+                ),
+                lambda: (o, l, m),
             )
 
         def body(t, carry):
