@@ -86,19 +86,22 @@ class FLController:
             fl_process_id=process.id, is_server_config=True
         )
 
-        reject_reason = None
-        if self.cycle_manager.is_assigned(cycle.id, worker.id):
-            reject_reason = "already in cycle"
-        elif not self.worker_manager.is_eligible(worker, server_config):
-            reject_reason = "bandwidth"
-        else:
-            dont_reuse = server_config.get("do_not_reuse_workers_until_cycle")
-            if dont_reuse:
-                last_seq = self.cycle_manager.last_participation(
-                    process.id, worker.id
-                )
-                if last_seq > 0 and cycle.sequence < last_seq + dont_reuse:
-                    reject_reason = "reuse window"
+        # shared gates with HTTP /req-join (selection.eligibility_reason)
+        # so the WS and HTTP admission paths cannot drift
+        from pygrid_tpu.federated.selection import eligibility_reason
+
+        reject_reason = eligibility_reason(
+            server_config=server_config,
+            cycle_sequence=cycle.sequence,
+            already_in_cycle=self.cycle_manager.is_assigned(
+                cycle.id, worker.id
+            ),
+            last_participation=self.cycle_manager.last_participation(
+                process.id, worker.id
+            ),
+            up_speed=worker.avg_upload or 0,
+            down_speed=worker.avg_download or 0,
+        )
         if reject_reason is not None:
             response: dict[str, Any] = {CYCLE.STATUS: CYCLE.REJECTED}
             if cycle.end is not None:

@@ -106,6 +106,9 @@ class CycleManager:
     def is_assigned(self, cycle_id: int, worker_id: str) -> bool:
         return self._worker_cycles.contains(cycle_id=cycle_id, worker_id=worker_id)
 
+    def workers_in_cycle(self, cycle_id: int) -> int:
+        return self._worker_cycles.count(cycle_id=cycle_id)
+
     def validate(self, worker_id: str, cycle_id: int, request_key: str) -> S.WorkerCycle:
         wc = self._worker_cycles.first(
             worker_id=worker_id, cycle_id=cycle_id, request_key=request_key

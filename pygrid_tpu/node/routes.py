@@ -166,6 +166,62 @@ async def mc_get_protocol(request: web.Request) -> web.Response:
         return _json_error(err, _status_for(err))
 
 
+async def mc_req_join(request: web.Request) -> web.Response:
+    """Probabilistic cycle-admission decision (reference routes.py:287-468,
+    the ``/req-join`` Poisson worker-selection endpoint). Accepts by model
+    name+version (or fl_process id), worker speeds and id; returns
+    ``{"status": "accepted"|"rejected"}`` with 200/400 like the reference."""
+    import datetime as dt
+
+    from pygrid_tpu.federated.selection import should_admit
+
+    ctx = _ctx(request)
+    try:
+        q = request.query
+        if q.get("model_id"):
+            process = ctx.fl.process_manager.first(id=int(q["model_id"]))
+        else:
+            filters: dict[str, Any] = {"name": q.get("name")}
+            if q.get("version"):
+                filters["version"] = q["version"]
+            process = ctx.fl.process_manager.first(**filters)
+        cycle = ctx.fl.cycle_manager.last(process.id)
+        server_config = ctx.fl.process_manager.get_configs(
+            fl_process_id=process.id, is_server_config=True
+        )
+        worker_id = q.get("worker_id", "")
+        time_left = None
+        if cycle.end is not None:
+            now = dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+            time_left = (cycle.end - now).total_seconds()
+        decision = should_admit(
+            server_config=server_config,
+            cycle_sequence=cycle.sequence,
+            cycle_time_left=time_left,
+            workers_in_cycle=ctx.fl.cycle_manager.workers_in_cycle(cycle.id),
+            already_in_cycle=ctx.fl.cycle_manager.is_assigned(
+                cycle.id, worker_id
+            ),
+            last_participation=ctx.fl.cycle_manager.last_participation(
+                process.id, worker_id
+            ),
+            up_speed=float(q.get("up_speed", 0)),
+            down_speed=float(q.get("down_speed", 0)),
+            # observed join rate; the reference hard-codes 5/unit-time
+            # (routes.py:384) — here overridable per request for ops/tests
+            request_rate=float(q.get("request_rate", 5.0)),
+        )
+        status = "accepted" if decision.accepted else "rejected"
+        return web.json_response(
+            {"status": status, "reason": decision.reason},
+            status=200 if decision.accepted else 400,
+        )
+    except (ValueError, TypeError) as err:  # malformed query params
+        return _json_error(err, 400)
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
 async def mc_retrieve_model(request: web.Request) -> web.Response:
     """Public checkpoint download by name/version/checkpoint alias or number
     (reference routes.py:471-516)."""
@@ -376,6 +432,7 @@ def register(app: web.Application) -> None:
     r.add_get("/model-centric/get-model", mc_get_model)
     r.add_get("/model-centric/get-plan", mc_get_plan)
     r.add_get("/model-centric/get-protocol", mc_get_protocol)
+    r.add_get("/model-centric/req-join", mc_req_join)
     r.add_get("/model-centric/retrieve-model", mc_retrieve_model)
     # data-centric (reference blueprint /data-centric)
     r.add_get("/data-centric/models/", dc_models)

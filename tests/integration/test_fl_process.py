@@ -177,3 +177,24 @@ def test_worker_already_in_cycle_rejected(grid, hosted):
     again = client.cycle_request(wid, NAME, VERSION, 1.0, 100.0, 100.0)
     assert again["status"] == "rejected"
     client.close()
+
+
+def test_req_join_admission(grid, hosted):
+    """Poisson admission endpoint (reference routes.py:287-468): eligible
+    workers get accepted (hosted config has no bandwidth minima and huge
+    cycle_length), slow ones get a 400 reject."""
+    import requests
+
+    url = grid.node_url("alice") + "/model-centric/req-join"
+    ok = requests.get(url, params={
+        "name": NAME, "version": VERSION, "worker_id": "fresh-worker",
+        "up_speed": "99999", "down_speed": "99999",
+        "request_rate": "0.00001",  # scarce joins → deterministic accept
+    }, timeout=10)
+    assert ok.status_code == 200 and ok.json()["status"] == "accepted"
+
+    slow = requests.get(url, params={
+        "name": NAME, "version": VERSION, "worker_id": "slow-worker",
+        "up_speed": "-1", "down_speed": "0",
+    }, timeout=10)
+    assert slow.status_code == 400 and slow.json()["status"] == "rejected"

@@ -1,0 +1,99 @@
+"""Worker-admission policy tests (reference routes.py:287-468 semantics)."""
+
+from __future__ import annotations
+
+import math
+import random
+
+from pygrid_tpu.federated.selection import (
+    AdmissionDecision,
+    poisson_sf,
+    should_admit,
+    solve_admission_rate,
+)
+
+BASE_CONFIG = {
+    "max_workers": 100,
+    "pool_selection": "random",
+    "num_cycles": 5,
+    "do_not_reuse_workers_until_cycle": 4,
+    "cycle_length": 8 * 60 * 60,
+    "minimum_upload_speed": 2000,
+    "minimum_download_speed": 4000,
+}
+
+
+def _admit(**overrides) -> AdmissionDecision:
+    kwargs = dict(
+        server_config=BASE_CONFIG,
+        cycle_sequence=2,
+        cycle_time_left=4 * 3600.0,
+        workers_in_cycle=0,
+        already_in_cycle=False,
+        last_participation=0,
+        up_speed=5000.0,
+        down_speed=8000.0,
+        rng=random.Random(0),
+    )
+    kwargs.update(overrides)
+    return should_admit(**kwargs)
+
+
+def test_poisson_sf_matches_closed_forms():
+    # P(K > 0) = 1 - e^-lam
+    assert math.isclose(poisson_sf(0, 2.0), 1 - math.exp(-2.0), rel_tol=1e-12)
+    assert poisson_sf(10, 0.0) == 0.0
+    # large k, small rate → essentially impossible
+    assert poisson_sf(120, 5.0) < 1e-10
+
+
+def test_solve_admission_rate_hits_confidence():
+    k_prime = 120.0  # 100 workers × 1.2 failure padding
+    lam = solve_admission_rate(k_prime)
+    assert poisson_sf(k_prime, lam) >= 0.95
+    assert poisson_sf(k_prime, lam - 1) < 0.95  # smallest such rate
+
+
+def test_bandwidth_gates():
+    assert not _admit(up_speed=100.0).accepted
+    assert not _admit(down_speed=100.0).accepted
+
+
+def test_reuse_window_blocks_recent_participant():
+    # participated in cycle 1, window 4 → blocked until cycle 5
+    assert not _admit(last_participation=1, cycle_sequence=2).accepted
+    cleared = _admit(
+        last_participation=1, cycle_sequence=5, request_rate=0.001
+    )
+    assert cleared.accepted  # out of the window (scarce requests → no lottery)
+
+
+def test_cycle_exhaustion_and_deadline():
+    assert not _admit(cycle_sequence=6).accepted
+    assert not _admit(cycle_time_left=10.0).accepted
+    assert not _admit(already_in_cycle=True).accepted
+
+
+def test_iterate_pool_fcfs_with_padding():
+    config = dict(BASE_CONFIG, pool_selection="iterate")
+    assert _admit(server_config=config, workers_in_cycle=0).accepted
+    # 100 × (1 + 0.2) = 120 over-admission cap
+    assert _admit(server_config=config, workers_in_cycle=119).accepted
+    assert not _admit(server_config=config, workers_in_cycle=120).accepted
+
+
+def test_random_pool_admits_all_when_requests_scarce():
+    # expected requests below quota → never reject
+    decision = _admit(request_rate=0.001)
+    assert decision.accepted and "shortage" in decision.reason
+
+
+def test_random_pool_lottery_rate():
+    # λ_actual = 5/s × 4h »_approx → admission prob ≈ λ_approx/λ_actual
+    rng = random.Random(42)
+    admitted = sum(
+        _admit(rng=rng).accepted for _ in range(2000)
+    )
+    lam_approx = solve_admission_rate(120.0)
+    expected = lam_approx / (5.0 * 4 * 3600.0)
+    assert abs(admitted / 2000 - expected) < 0.01
