@@ -16,7 +16,13 @@ from typing import Any
 
 from websockets.sync.client import connect
 
+from pygrid_tpu.native import install_ws_masking
 from pygrid_tpu.utils.codes import MSG_FIELD
+
+# client→server frames are masked; swap in the native XOR when websockets
+# would otherwise mask byte-by-byte in Python (the analog of the
+# reference's geventwebsocket masking patch, util.py:5-24)
+install_ws_masking()
 
 
 class GridWSClient:

@@ -95,8 +95,13 @@ class FLJob:
             self._emit(self.EVENT_ERROR, err)
 
     def report(self, diff_params: list) -> dict:
-        """Upload the weight diff (reference fl_events.py report:237-271)."""
-        blob = serialize_model_params(list(diff_params))
+        """Upload the weight diff (reference fl_events.py report:237-271).
+
+        When the hosted process sets ``client_config["diff_precision"] =
+        "bf16"`` the diff travels as bfloat16 — half the upload bytes, the
+        dtype the aggregation runs in on TPU anyway."""
+        bf16 = self.client_config.get("diff_precision") == "bf16"
+        blob = serialize_model_params(list(diff_params), bf16=bf16)
         return self.client.report(self.worker_id, self.request_key, blob)
 
 

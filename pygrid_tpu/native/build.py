@@ -1,0 +1,55 @@
+"""Build the native library on demand.
+
+The reference ships its native pieces as pip wheels (wsaccel, protobuf);
+this framework compiles its single C++ translation unit at first use with
+whatever ``g++``/``clang++`` is on PATH and caches the ``.so`` next to the
+source keyed by mtime. No toolchain → callers fall back to numpy paths."""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import subprocess
+import sysconfig
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "src" / "pygrid_native.cpp"
+
+
+def _lib_path() -> Path:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return Path(__file__).parent / "_build" / f"libpygrid_native{suffix}"
+
+
+def ensure_built(force: bool = False) -> Path | None:
+    """Compile if stale/missing; returns the library path or None."""
+    lib = _lib_path()
+    if (
+        not force
+        and lib.exists()
+        and lib.stat().st_mtime >= _SRC.stat().st_mtime
+    ):
+        return lib
+    compiler = (
+        os.environ.get("CXX") or shutil.which("g++") or shutil.which("clang++")
+    )
+    if compiler is None:
+        logger.info("pygrid_tpu.native: no C++ compiler; using numpy paths")
+        return None
+    lib.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        compiler, "-O3", "-shared", "-fPIC", "-std=c++17",
+        str(_SRC), "-o", str(lib),
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as err:
+        detail = getattr(err, "stderr", "") or str(err)
+        logger.warning("pygrid_tpu.native build failed: %s", detail)
+        return None
+    return lib

@@ -1,0 +1,73 @@
+// Native runtime kernels for the host-side hot paths.
+//
+// Parity rationale: the reference's serving plane leans on native code for
+// exactly these spots — wsaccel (C websocket masking, apps/node/
+// pyproject.toml:31) plus a numpy XOR patch (apps/node/src/app/util.py:5-24),
+// and protobuf's C++ for tensor payload packing. Here the equivalents are a
+// word-wide XOR mask and float32<->bfloat16 wire conversion (TPU-native
+// payload dtype), exported with a plain C ABI for ctypes.
+//
+// Build: pygrid_tpu/native/build.py shells out to g++ -O3 -shared -fPIC.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// XOR-mask `n` bytes of `buf` in place with the 4-byte websocket mask.
+// Word-wide main loop (the per-byte tail is at most 7 iterations); with -O3
+// the 64-bit loop auto-vectorizes.
+void pg_xor_mask(uint8_t *buf, uint64_t n, const uint8_t mask[4]) {
+    uint64_t wide;
+    uint8_t rep[8];
+    for (int i = 0; i < 8; ++i) rep[i] = mask[i & 3];
+    std::memcpy(&wide, rep, 8);
+
+    uint64_t i = 0;
+    // align to 8 so the wide loop reads aligned words
+    for (; i < n && (reinterpret_cast<uintptr_t>(buf + i) & 7); ++i)
+        buf[i] ^= mask[i & 3];
+    // the mask phase at offset i: rotate the replicated word to match
+    uint64_t phase = i & 3;
+    uint64_t m = wide;
+    if (phase) {
+        uint8_t rot[8];
+        for (int k = 0; k < 8; ++k) rot[k] = rep[(k + phase) & 3];
+        std::memcpy(&m, rot, 8);
+    }
+    for (; i + 8 <= n; i += 8) {
+        uint64_t w;
+        std::memcpy(&w, buf + i, 8);
+        w ^= m;
+        std::memcpy(buf + i, &w, 8);
+    }
+    for (; i < n; ++i) buf[i] ^= mask[i & 3];
+}
+
+// float32 -> bfloat16 with round-to-nearest-even (matches XLA/ml_dtypes).
+// NaNs are quieted to 0x7fc0-style payloads by the +rounding carry being
+// suppressed: standard trick — if NaN, emit the truncated bits with the
+// quiet bit forced.
+void pg_f32_to_bf16(const uint32_t *src, uint16_t *dst, uint64_t n) {
+    for (uint64_t i = 0; i < n; ++i) {
+        uint32_t x = src[i];
+        uint32_t exp = x & 0x7f800000u;
+        if (exp == 0x7f800000u && (x & 0x007fffffu)) {
+            dst[i] = static_cast<uint16_t>((x >> 16) | 0x0040u);  // quiet NaN
+        } else {
+            uint32_t rounding = 0x7fffu + ((x >> 16) & 1u);
+            dst[i] = static_cast<uint16_t>((x + rounding) >> 16);
+        }
+    }
+}
+
+// bfloat16 -> float32 (exact: left shift).
+void pg_bf16_to_f32(const uint16_t *src, uint32_t *dst, uint64_t n) {
+    for (uint64_t i = 0; i < n; ++i)
+        dst[i] = static_cast<uint32_t>(src[i]) << 16;
+}
+
+int pg_abi_version(void) { return 1; }
+
+}  // extern "C"

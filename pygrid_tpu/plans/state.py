@@ -46,9 +46,17 @@ class State:
         return f"State({self.state_placeholders!r})"
 
 
-def serialize_model_params(params: Sequence[Any]) -> bytes:
-    """list-of-arrays -> wire bytes (reference model_manager.py:80-92)."""
-    return serialize(State.from_tensors([np.asarray(p) for p in params]))
+def serialize_model_params(
+    params: Sequence[Any], *, bf16: bool = False
+) -> bytes:
+    """list-of-arrays -> wire bytes (reference model_manager.py:80-92).
+
+    ``bf16=True`` ships float32 params as bfloat16 bit patterns (half the
+    upload bytes; the FL diff path opts in via client_config)."""
+    return serialize(
+        State.from_tensors([np.asarray(p) for p in params]),
+        bf16_floats=bf16,
+    )
 
 
 def unserialize_model_params(blob: bytes) -> list[np.ndarray]:

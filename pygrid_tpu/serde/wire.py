@@ -29,6 +29,10 @@ WIRE_VERSION = 1
 
 EXT_NDARRAY = 0x01
 EXT_OBJECT = 0x02
+#: float32 array carried as bfloat16 bit patterns — half the bytes on the
+#: wire (the TPU-native payload dtype); decodes back to float32. Written
+#: only when the sender opts in via ``serialize(..., bf16_floats=True)``.
+EXT_NDARRAY_BF16 = 0x03
 
 # type name -> (cls, bufferize, unbufferize)
 _REGISTRY: dict[str, tuple[type, Callable, Callable]] = {}
@@ -78,35 +82,70 @@ def _unpack_ndarray(payload: bytes) -> np.ndarray:
     return np.frombuffer(bytearray(raw), dtype=np.dtype(dtype_str)).reshape(shape)
 
 
-def _default(obj: Any):
-    if isinstance(obj, np.ndarray):
-        return _pack_ndarray(obj)
-    if isinstance(obj, (np.generic,)):
-        return _pack_ndarray(np.asarray(obj))
-    if _is_jax_array(obj) and hasattr(obj, "dtype") and hasattr(obj, "shape"):
-        return _pack_ndarray(np.asarray(obj))
-    cls = type(obj)
-    # exact-class lookup only: silently serializing a subclass through its
-    # base would drop overridden fields and downcast on the far side
-    type_name = _CLS_NAMES.get(cls)
-    if type_name is not None:
-        _, bufferize, _ = _REGISTRY[type_name]
-        # Type name packed as its own leading msgpack object (not inside one
-        # array) so deserialization can read it without decoding the payload.
-        inner = msgpack.packb(type_name, use_bin_type=True) + msgpack.packb(
-            bufferize(obj), use_bin_type=True, default=_default
-        )
-        return msgpack.ExtType(EXT_OBJECT, inner)
-    if isinstance(obj, set):
-        return sorted(obj)
-    if isinstance(obj, tuple):
-        return list(obj)
-    raise TypeError(f"pygrid_tpu.serde: cannot serialize {cls!r}")
+def _pack_ndarray_bf16(arr: np.ndarray) -> msgpack.ExtType:
+    from pygrid_tpu.native import f32_to_bf16
+
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    payload = msgpack.packb(
+        [list(arr.shape), f32_to_bf16(arr).tobytes()], use_bin_type=True
+    )
+    return msgpack.ExtType(EXT_NDARRAY_BF16, payload)
+
+
+def _unpack_ndarray_bf16(payload: bytes) -> np.ndarray:
+    from pygrid_tpu.native import bf16_to_f32
+
+    shape, raw = msgpack.unpackb(payload, raw=False)
+    bits = np.frombuffer(bytearray(raw), dtype=np.uint16)
+    return bf16_to_f32(bits).reshape(shape)
+
+
+def _make_default(bf16_floats: bool):
+    def _default(obj: Any):
+        if isinstance(obj, np.ndarray) or isinstance(obj, np.generic):
+            arr = np.asarray(obj)
+        elif (
+            _is_jax_array(obj)
+            and hasattr(obj, "dtype")
+            and hasattr(obj, "shape")
+        ):
+            arr = np.asarray(obj)
+        else:
+            arr = None
+        if arr is not None:
+            if bf16_floats and arr.dtype == np.float32:
+                return _pack_ndarray_bf16(arr)
+            return _pack_ndarray(arr)
+        cls = type(obj)
+        # exact-class lookup only: silently serializing a subclass through
+        # its base would drop overridden fields and downcast on the far side
+        type_name = _CLS_NAMES.get(cls)
+        if type_name is not None:
+            _, bufferize, _ = _REGISTRY[type_name]
+            # Type name packed as its own leading msgpack object (not inside
+            # one array) so deserialization can read it without decoding the
+            # payload.
+            inner = msgpack.packb(type_name, use_bin_type=True) + msgpack.packb(
+                bufferize(obj), use_bin_type=True, default=_default
+            )
+            return msgpack.ExtType(EXT_OBJECT, inner)
+        if isinstance(obj, set):
+            return sorted(obj)
+        if isinstance(obj, tuple):
+            return list(obj)
+        raise TypeError(f"pygrid_tpu.serde: cannot serialize {cls!r}")
+
+    return _default
+
+
+_default = _make_default(bf16_floats=False)
 
 
 def _ext_hook(code: int, payload: bytes):
     if code == EXT_NDARRAY:
         return _unpack_ndarray(payload)
+    if code == EXT_NDARRAY_BF16:
+        return _unpack_ndarray_bf16(payload)
     if code == EXT_OBJECT:
         unpacker = msgpack.Unpacker(
             raw=False, ext_hook=_ext_hook, strict_map_key=False
@@ -149,9 +188,13 @@ def _ensure_registered(type_name: str) -> None:
             return
 
 
-def serialize(obj: Any) -> bytes:
-    """Serialize ``obj`` (tensors, registered objects, plain structures)."""
-    return msgpack.packb(obj, use_bin_type=True, default=_default)
+def serialize(obj: Any, *, bf16_floats: bool = False) -> bytes:
+    """Serialize ``obj`` (tensors, registered objects, plain structures).
+
+    ``bf16_floats=True`` sends float32 arrays as bfloat16 bit patterns —
+    half the wire bytes, decoded back to float32 by any receiver."""
+    default = _make_default(bf16_floats) if bf16_floats else _default
+    return msgpack.packb(obj, use_bin_type=True, default=default)
 
 
 def deserialize(blob: bytes | bytearray | memoryview) -> Any:

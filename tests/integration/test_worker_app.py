@@ -43,6 +43,9 @@ def hosted(grid):
             "batch_size": B,
             "lr": 0.1,
             "max_updates": 1,
+            # diffs travel as bfloat16 (native wire path) — the node's
+            # deserialize recovers float32 transparently
+            "diff_precision": "bf16",
         },
         server_config={
             "min_workers": 1,
