@@ -14,3 +14,14 @@ from pygrid_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from pygrid_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline_training_step,
+    pipeline_apply,
+    sequential_apply,
+)
+from pygrid_tpu.parallel.distributed import (  # noqa: F401
+    data_sharding,
+    host_array,
+    hybrid_mesh,
+    local_batch_slice,
+)
