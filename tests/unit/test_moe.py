@@ -1,0 +1,79 @@
+"""Expert-parallel MoE vs the dense compute-every-expert reference."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from pygrid_tpu.models import moe
+
+P_SZ, D, FF, E, T = 4, 8, 16, 8, 32
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:P_SZ]), ("expert",))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return moe.init(jax.random.PRNGKey(0), D, FF, E)
+
+
+def test_expert_parallel_matches_dense(mesh, params):
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, D))
+    want = moe.apply_dense(params, x)
+    # generous capacity → no token drops → exact match
+    got = moe.apply_expert_parallel(
+        params, x, mesh, capacity_factor=float(E)
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_capacity_drops_tokens_deterministically(mesh, params):
+    """With capacity 1 per expert-shard, overflow tokens contribute zero
+    (GShard drop semantics) — output is a masked version of dense."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (T, D))
+    dense = np.asarray(moe.apply_dense(params, x))
+    got = np.asarray(
+        moe.apply_expert_parallel(params, x, mesh, capacity_factor=0.125)
+    )
+    # every row is either the dense value or exactly zero
+    row_match = np.isclose(got, dense, atol=1e-5).all(axis=1)
+    row_zero = np.isclose(got, 0.0).all(axis=1)
+    assert np.all(row_match | row_zero)
+    assert row_zero.any(), "capacity 1 should drop something"
+
+
+def test_gradients_flow_through_dispatch(mesh, params):
+    x = jax.random.normal(jax.random.PRNGKey(3), (T, D))
+
+    def loss_ep(p):
+        return jnp.mean(
+            moe.apply_expert_parallel(p, x, mesh, capacity_factor=float(E))
+            ** 2
+        )
+
+    def loss_dense(p):
+        return jnp.mean(moe.apply_dense(p, x) ** 2)
+
+    g_ep = jax.grad(loss_ep)(params)
+    g_dense = jax.grad(loss_dense)(params)
+    # expert FFN grads must agree (gate grads differ: dense routes through
+    # a softmax-of-all-experts select, EP through the dispatch one-hots)
+    for a, b in zip(g_ep[1:], g_dense[1:]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_shape_validation(mesh, params):
+    with pytest.raises(ValueError):
+        moe.apply_expert_parallel(
+            params, jnp.zeros((T + 1, D)), mesh
+        )
+    bad = moe.init(jax.random.PRNGKey(0), D, FF, E + 1)
+    with pytest.raises(ValueError):
+        moe.apply_expert_parallel(bad, jnp.zeros((T, D)), mesh)
