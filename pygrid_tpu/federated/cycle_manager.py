@@ -185,40 +185,51 @@ class CycleManager:
     def _average_plan_diffs(
         self, process: S.FLProcess, cycle: S.Cycle, server_config: dict
     ) -> None:
-        """(reference :219-323) average diffs → new checkpoint → next cycle."""
-        diffs = self._received_diffs(cycle.id)
-        model = self.model_manager.get(fl_process_id=process.id)
-        ckpt = self.model_manager.load(model_id=model.id, alias="latest")
-        params = unserialize_model_params(ckpt.value)
+        """(reference :219-323) average diffs → new checkpoint → next cycle.
+        Timed under ``cycle.aggregate`` (surfaced by /data-centric/status/)."""
+        from pygrid_tpu.utils.profiling import timed
 
-        diff_params = [unserialize_model_params(d) for d in diffs]
-        avg_plan_rec = self.plan_manager._plans.first(
-            fl_process_id=process.id, is_avg_plan=True
-        )
-        if avg_plan_rec is not None and avg_plan_rec.value_xla:
-            avg_diff = self._run_avg_plan(
-                avg_plan_rec, diff_params, server_config
+        with timed("cycle.aggregate"):
+            diffs = self._received_diffs(cycle.id)
+            model = self.model_manager.get(fl_process_id=process.id)
+            ckpt = self.model_manager.load(model_id=model.id, alias="latest")
+            params = unserialize_model_params(ckpt.value)
+
+            diff_params = [unserialize_model_params(d) for d in diffs]
+            avg_plan_rec = self.plan_manager._plans.first(
+                fl_process_id=process.id, is_avg_plan=True
             )
-        else:
-            # hardcoded FedAvg fallback (reference reduce(th.add)/th.div
-            # :275-290) — stacked mean in one XLA launch
-            stacked = [
-                jnp.stack([np.asarray(d[i]) for d in diff_params])
-                for i in range(len(params))
-            ]
-            avg_diff = _mean_stacked(stacked)
+            if avg_plan_rec is not None and avg_plan_rec.value_xla:
+                avg_diff = self._run_avg_plan(
+                    avg_plan_rec, diff_params, server_config
+                )
+            else:
+                # hardcoded FedAvg fallback (reference reduce(th.add)/th.div
+                # :275-290) — stacked mean in one XLA launch
+                stacked = [
+                    jnp.stack([np.asarray(d[i]) for d in diff_params])
+                    for i in range(len(params))
+                ]
+                avg_diff = _mean_stacked(stacked)
 
-        new_params = _apply_avg_diff([jnp.asarray(p) for p in params], avg_diff)
-        self.model_manager.save(
-            model.id, serialize_model_params([np.asarray(p) for p in new_params])
-        )
-        self._cycles.modify({"id": cycle.id}, {"is_completed": True})
+            new_params = _apply_avg_diff(
+                [jnp.asarray(p) for p in params], avg_diff
+            )
+            self.model_manager.save(
+                model.id,
+                serialize_model_params([np.asarray(p) for p in new_params]),
+            )
+            self._cycles.modify({"id": cycle.id}, {"is_completed": True})
 
-        num_cycles = server_config.get("num_cycles")
-        if num_cycles is not None and cycle.sequence >= num_cycles:
-            logger.info("FL process %s (%s) completed!", process.id, process.name)
-            return
-        self.create(process.id, cycle.version, server_config.get("cycle_length"))
+            num_cycles = server_config.get("num_cycles")
+            if num_cycles is not None and cycle.sequence >= num_cycles:
+                logger.info(
+                    "FL process %s (%s) completed!", process.id, process.name
+                )
+                return
+            self.create(
+                process.id, cycle.version, server_config.get("cycle_length")
+            )
 
     def _run_avg_plan(
         self, avg_plan_rec: S.PlanRecord, diff_params: list[list], server_config: dict

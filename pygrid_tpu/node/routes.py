@@ -285,7 +285,9 @@ async def dc_identity(request: web.Request) -> web.Response:
 
 
 async def dc_status(request: web.Request) -> web.Response:
-    return web.json_response({"status": "OK"})
+    from pygrid_tpu.utils.profiling import stats
+
+    return web.json_response({"status": "OK", "timings": stats.snapshot()})
 
 
 async def dc_workers(request: web.Request) -> web.Response:

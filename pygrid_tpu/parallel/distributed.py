@@ -1,0 +1,94 @@
+"""Multi-host mesh construction — the DCN-scale communication backend.
+
+Parity surface: the reference scales out with one Flask process per Node
+and HTTP/WS fan-out between them (SURVEY.md §2.6); its "multi-host
+backend" is sockets. The TPU-native equivalent is a **hybrid mesh**:
+an outer axis over hosts (collectives ride DCN) × inner axes over each
+host's chips (collectives ride ICI). Shardings choose which axes a
+collective crosses, so data parallelism lands on DCN while tensor/
+sequence/expert parallelism stays on ICI — the layout "How to Scale Your
+Model" prescribes and the reference's socket mesh cannot express.
+
+``hybrid_mesh`` builds that from the live topology (via
+``jax.experimental.mesh_utils``); ``local_batch_slice`` carves the
+process-local shard of a globally-sharded batch; ``host_array`` assembles
+a global array from per-host shards (``jax.make_array_from_process_local_data``).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def hybrid_mesh(
+    dcn_axis: str = "data",
+    ici_axes: tuple[str, ...] = ("model",),
+    ici_shape: tuple[int, ...] | None = None,
+    devices: list | None = None,
+    num_hosts: int | None = None,
+) -> Mesh:
+    """Mesh with a host-count outer axis (DCN) × per-host inner axes (ICI).
+
+    Single-host degenerates to ``dcn_axis`` size 1, so the same program
+    runs unchanged from a laptop to a pod slice."""
+    devices = devices if devices is not None else jax.devices()
+    n_hosts = num_hosts or max(
+        1, len({d.process_index for d in devices})
+    )
+    per_host = len(devices) // n_hosts
+    if n_hosts * per_host != len(devices):
+        raise ValueError(
+            f"{len(devices)} devices don't split over {n_hosts} hosts"
+        )
+    if ici_shape is None:
+        ici_shape = (per_host,) if len(ici_axes) == 1 else None
+    if ici_shape is None or int(np.prod(ici_shape)) != per_host:
+        raise ValueError(
+            f"ici_shape {ici_shape} must multiply to {per_host} "
+            f"devices per host"
+        )
+    if n_hosts > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            mesh_devices = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=ici_shape,
+                dcn_mesh_shape=(n_hosts,) + (1,) * len(ici_shape),
+                devices=devices,
+            ).reshape((n_hosts,) + tuple(ici_shape))
+        except ValueError:
+            # virtual/CPU devices carry no slice_index topology — group by
+            # enumeration order (what the force-host-device simulation uses)
+            mesh_devices = np.asarray(devices).reshape(
+                (n_hosts,) + tuple(ici_shape)
+            )
+    else:
+        mesh_devices = np.asarray(devices).reshape((1,) + tuple(ici_shape))
+    return Mesh(mesh_devices, (dcn_axis,) + tuple(ici_axes))
+
+
+def data_sharding(mesh: Mesh, dcn_axis: str = "data") -> NamedSharding:
+    """Batch split over hosts (DCN axis), replicated over ICI axes."""
+    return NamedSharding(mesh, P(dcn_axis))
+
+
+def local_batch_slice(
+    global_batch: int, mesh: Mesh, dcn_axis: str = "data"
+) -> slice:
+    """This process's rows of a batch sharded over the DCN axis."""
+    n = mesh.shape[dcn_axis]
+    if global_batch % n:
+        raise ValueError(f"batch {global_batch} not divisible by {n} hosts")
+    per = global_batch // n
+    idx = jax.process_index() % n
+    return slice(idx * per, (idx + 1) * per)
+
+
+def host_array(local_data, mesh: Mesh, spec: P):
+    """Assemble a global jax.Array from per-process shards (the multi-host
+    feed path: each host reads only its slice from storage)."""
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), np.asarray(local_data)
+    )

@@ -188,10 +188,30 @@ def _shift_left_u64(p_u32: jax.Array, off: int) -> Ring64:
     return Ring64(jnp.zeros_like(p_u32), p_u32 << (off - 32))
 
 
+#: tri-state Pallas dispatch override: None = env/platform default
+_PALLAS_ENABLED: bool | None = None
+
+
+def set_pallas_enabled(enabled: bool | None) -> None:
+    """Runtime kill-switch for the Pallas matmul dispatch.
+
+    The dispatch decision is read at **trace time**, so flipping it must
+    also drop cached executables — this clears the jit caches so every
+    already-traced shape retraces with the new setting."""
+    global _PALLAS_ENABLED
+    _PALLAS_ENABLED = enabled
+    jax.clear_caches()
+
+
 def _pallas_eligible(a: Ring64, b: Ring64) -> bool:
     import os
 
-    if os.environ.get("PYGRID_TPU_NO_PALLAS"):
+    if _PALLAS_ENABLED is not None:
+        if not _PALLAS_ENABLED:
+            return False
+    elif os.environ.get("PYGRID_TPU_NO_PALLAS"):
+        # env read at trace time: set it before first use, or use
+        # set_pallas_enabled() to flip a live process
         return False
     if a.lo.ndim != 2 or b.lo.ndim != 2:
         return False

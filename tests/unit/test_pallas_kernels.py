@@ -8,23 +8,12 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
-
 from pygrid_tpu.smpc import ring as R
 from pygrid_tpu.smpc.pallas_kernels import pallas_ring_matmul
 
 
-def _to_ring(x: np.ndarray) -> R.Ring64:
-    return R.Ring64(
-        jnp.asarray((x & 0xFFFFFFFF).astype(np.uint32)),
-        jnp.asarray((x >> 32).astype(np.uint32)),
-    )
-
-
-def _to_np(r: R.Ring64) -> np.ndarray:
-    return (
-        np.asarray(r.hi, dtype=np.uint64) << np.uint64(32)
-    ) | np.asarray(r.lo, dtype=np.uint64)
+_to_ring = R.to_ring
+_to_np = R.from_ring
 
 
 @pytest.mark.parametrize(
@@ -43,11 +32,17 @@ def test_matches_numpy_uint64(m, k, n):
 
 
 def test_matches_xla_limb_path():
+    """Kernel vs the XLA limb path — with the Pallas dispatch force-disabled
+    so ring_matmul really takes the XLA route even on tpu/axon backends."""
     rng = np.random.default_rng(0)
     a = rng.integers(0, 2**64, size=(64, 256), dtype=np.uint64)
     b = rng.integers(0, 2**64, size=(256, 32), dtype=np.uint64)
     ra, rb = _to_ring(a), _to_ring(b)
-    xla = R.ring_matmul(ra, rb)
+    R.set_pallas_enabled(False)
+    try:
+        xla = R.ring_matmul(ra, rb)
+    finally:
+        R.set_pallas_enabled(None)
     pallas = pallas_ring_matmul(ra, rb, interpret=True)
     np.testing.assert_array_equal(np.asarray(xla.lo), np.asarray(pallas.lo))
     np.testing.assert_array_equal(np.asarray(xla.hi), np.asarray(pallas.hi))
