@@ -34,6 +34,10 @@ class NodeProxy:
         self.hosted_datasets: list = []
         self.cpu_percent: float | None = None
         self.mem_usage: float | None = None
+        #: reference worker.py:47-61 resolves this via an external geo-IP
+        #: service; here nodes self-report it (NODE_LOCATION env / monitor
+        #: answer) — no egress dependency
+        self.location: str | None = None
         self._monitor_sent_at: float | None = None
 
     @property
@@ -63,6 +67,8 @@ class NodeProxy:
         self.hosted_datasets = message.get("datasets") or []
         self.cpu_percent = message.get("cpu")
         self.mem_usage = message.get("mem")
+        if message.get("location"):
+            self.location = message["location"]
 
 
 async def poll_node(proxy: NodeProxy) -> None:
@@ -79,7 +85,9 @@ async def poll_node(proxy: NodeProxy) -> None:
                 if resp.status != 200:
                     proxy.mark_offline()
                     return
-                await resp.json()
+                status = await resp.json()
+                if status.get("location"):
+                    proxy.location = status["location"]
             proxy.ping = (time.monotonic() - t0) * 1000
             proxy.last_seen = time.time()
             async with session.get(

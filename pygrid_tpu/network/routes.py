@@ -253,6 +253,7 @@ async def nodes_status(request: web.Request) -> web.Response:
                 "ping_ms": p.ping,
                 "models": p.hosted_models,
                 "datasets": p.hosted_datasets,
+                "location": p.location,
             }
             for nid, p in ctx.proxies.items()
         }

@@ -285,9 +285,19 @@ async def dc_identity(request: web.Request) -> web.Response:
 
 
 async def dc_status(request: web.Request) -> web.Response:
+    import os
+
     from pygrid_tpu.utils.profiling import stats
 
-    return web.json_response({"status": "OK", "timings": stats.snapshot()})
+    return web.json_response(
+        {
+            "status": "OK",
+            "timings": stats.snapshot(),
+            # self-reported placement (reference resolves this via geo-IP,
+            # worker.py:47-61; zero-egress deployments set NODE_LOCATION)
+            "location": os.environ.get("NODE_LOCATION"),
+        }
+    )
 
 
 async def dc_workers(request: web.Request) -> web.Response:

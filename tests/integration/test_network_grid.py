@@ -166,3 +166,25 @@ def test_monitor_marks_nodes_online(grid):
             return
         time.sleep(0.3)
     pytest.fail(f"nodes never came online: {statuses}")
+
+
+def test_monitor_propagates_node_location(grid, monkeypatch):
+    """Self-reported placement flows node /status → monitor poll →
+    /nodes-status (the zero-egress analog of the reference's geo-IP,
+    worker.py:47-61)."""
+    monkeypatch.setenv("NODE_LOCATION", "us-central1-a")
+    st = requests.get(
+        grid.node_url("alice") + "/data-centric/status/", timeout=10
+    ).json()
+    assert st["location"] == "us-central1-a"
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        statuses = requests.get(
+            grid.network_url + "/nodes-status", timeout=10
+        ).json()
+        if any(
+            s.get("location") == "us-central1-a" for s in statuses.values()
+        ):
+            return
+        time.sleep(0.3)
+    pytest.fail(f"location never propagated: {statuses}")
