@@ -1,0 +1,169 @@
+"""Failure recovery: node restart resumes a mid-process FL cycle, and
+straggler time-up semantics complete a short-handed cycle.
+
+Parity surface: SURVEY.md §5.3/5.4 — "Cycle state is all in SQL, so a Node
+restart resumes mid-process" (reference keeps FLProcess/Cycle/WorkerCycle/
+Checkpoint rows in SQLAlchemy; stragglers are simply dropped when the
+cycle deadline passes, cycle_manager.py:195-215)."""
+
+from __future__ import annotations
+
+import datetime as dt
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from pygrid_tpu.client import FLClient, ModelCentricFLClient
+from pygrid_tpu.federated import tasks
+from pygrid_tpu.federated.auth import jwt_encode
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+
+from .conftest import ServerThread, _free_port
+
+SECRET = "resume-secret"
+NAME, VERSION = "resume-mnist", "1.0"
+D, H, C, B = 784, 16, 10, 8
+
+
+def _host(
+    node_url: str,
+    min_diffs: int,
+    cycle_length: int = 28800,
+    max_diffs: int | None = None,
+):
+    params = mlp.init(jax.random.PRNGKey(11), (D, H, C))
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *[np.asarray(p) for p in params],
+    )
+    client = ModelCentricFLClient(node_url)
+    response = client.host_federated_training(
+        model=[np.asarray(p) for p in params],
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": NAME, "version": VERSION, "batch_size": B,
+            "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": 1, "max_workers": 4,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+            "cycle_length": cycle_length, "num_cycles": 2,
+            "max_diffs": max_diffs or min_diffs, "min_diffs": min_diffs,
+            "authentication": {"secret": SECRET},
+        },
+    )
+    assert response.get("status") == "success"
+    client.close()
+
+
+def _report_one_diff(node_url: str) -> None:
+    client = FLClient(node_url, auth_token=jwt_encode({}, SECRET))
+    job = client.new_job(NAME, VERSION)
+    done = []
+
+    def on_accept(job):
+        params = [np.asarray(p) for p in job.model_params]
+        plan = job.plans["training_plan"]
+        X = np.zeros((B, D), np.float32)
+        y = np.eye(C, dtype=np.float32)[np.zeros(B, np.int64)]
+        out = plan(X, y, np.float32(0.1), *params)
+        diff = [p - np.asarray(n) for p, n in zip(params, out[2:])]
+        job.report(diff)
+        done.append(True)
+
+    job.add_listener(job.EVENT_ACCEPTED, on_accept)
+    job.add_listener(
+        job.EVENT_ERROR, lambda j, e: pytest.fail(f"job error: {e}")
+    )
+    job.start(ping=1.0, download=1000.0, upload=1000.0)
+    client.close()
+    assert done
+
+
+def test_node_restart_resumes_cycle(tmp_path):
+    """Host + 1-of-2 diffs → stop the server → new server process over the
+    same SQL/KV files → the second diff completes the cycle and writes
+    checkpoint 2."""
+    from pygrid_tpu.node import create_app
+
+    prev = tasks._sync
+    tasks.set_sync(True)
+    db_url = str(tmp_path / "node.db")
+    kv_path = str(tmp_path / "kv.db")
+    port = _free_port()
+    server = ServerThread(
+        create_app("phoenix", database_url=db_url, kv_path=kv_path),
+        port,
+    ).start()
+    try:
+        _host(server.url, min_diffs=2)
+        _report_one_diff(server.url)
+    finally:
+        server.stop()
+
+    # "restart": a fresh app instance over the same persisted state
+    port2 = _free_port()
+    server2 = ServerThread(
+        create_app("phoenix", database_url=db_url, kv_path=kv_path),
+        port2,
+    ).start()
+    try:
+        # process + open cycle + first worker-diff all survived
+        _report_one_diff(server2.url)
+        mc = ModelCentricFLClient(server2.url)
+        latest = mc.retrieve_model(NAME, VERSION)
+        first = mc.retrieve_model(NAME, VERSION, checkpoint=1)
+        assert any(
+            not np.allclose(a, b) for a, b in zip(latest, first)
+        ), "aggregation after restart did not advance the checkpoint"
+        mc.close()
+    finally:
+        server2.stop()
+        tasks.set_sync(prev)
+
+
+def test_straggler_drop_completes_short_cycle():
+    """min_diffs met but max_diffs not: aggregation waits while the cycle
+    is open, then the deadline passing drops the stragglers and the next
+    completion check aggregates (reference cycle_manager.py:195-215)."""
+    from pygrid_tpu.node import create_app
+
+    prev = tasks._sync
+    tasks.set_sync(True)
+    server = ServerThread(create_app("straggler"), _free_port()).start()
+    try:
+        _host(server.url, min_diffs=1, max_diffs=3)
+        _report_one_diff(server.url)
+        ctx = server.app["node"]
+        mc = ModelCentricFLClient(server.url)
+        first = mc.retrieve_model(NAME, VERSION, checkpoint=1)
+        # 1 of 3 diffs in, deadline 8h away → not ready, checkpoint still #1
+        latest = mc.retrieve_model(NAME, VERSION)
+        for a, b in zip(latest, first):
+            np.testing.assert_allclose(a, b)
+
+        # deadline passes (backdate in SQL) → time-up branch aggregates
+        process = ctx.fl.process_manager.first(name=NAME)
+        cycle = ctx.fl.cycle_manager.last(process.id)
+        past = dt.datetime.now(dt.timezone.utc).replace(
+            tzinfo=None
+        ) - dt.timedelta(seconds=1)
+        ctx.fl.cycle_manager._cycles.modify({"id": cycle.id}, {"end": past})
+        ctx.fl.cycle_manager.complete_cycle(cycle.id)
+
+        latest = mc.retrieve_model(NAME, VERSION)
+        assert any(
+            not np.allclose(a, b) for a, b in zip(latest, first)
+        ), "time-up cycle did not aggregate the straggler-short diffs"
+        mc.close()
+    finally:
+        server.stop()
+        tasks.set_sync(prev)
