@@ -40,7 +40,11 @@ def bench_tpu() -> float:
     client_y = jax.nn.one_hot(labels, SIZES[-1])
     lr = jnp.float32(LR)
 
-    round_fn = make_round(mlp.training_step, local_steps=1)
+    # single-pass bf16 MXU dots with f32 accumulation — measured ~5% over
+    # the platform default at these sizes, accuracy-neutral for FedAvg
+    round_fn = make_round(
+        mlp.training_step, local_steps=1, matmul_precision="BF16_BF16_F32"
+    )
     p, loss, acc = round_fn(params, client_X, client_y, lr)  # compile
     _ = float(loss)  # host fetch — on tunneled platforms block_until_ready
     # returns before execution completes; only a fetch truly syncs

@@ -49,23 +49,26 @@ def hybrid_mesh(
             f"ici_shape {ici_shape} must multiply to {per_host} "
             f"devices per host"
         )
-    if n_hosts > 1:
-        try:
-            from jax.experimental import mesh_utils
+    # topology-aware placement only when the devices really span n_hosts
+    # processes; a num_hosts override on single-process (virtual CPU)
+    # devices groups by enumeration order instead
+    real_multiprocess = (
+        n_hosts > 1
+        and len({d.process_index for d in devices}) == n_hosts
+    )
+    if real_multiprocess:
+        from jax.experimental import mesh_utils
 
-            mesh_devices = mesh_utils.create_hybrid_device_mesh(
-                mesh_shape=ici_shape,
-                dcn_mesh_shape=(n_hosts,) + (1,) * len(ici_shape),
-                devices=devices,
-            ).reshape((n_hosts,) + tuple(ici_shape))
-        except ValueError:
-            # virtual/CPU devices carry no slice_index topology — group by
-            # enumeration order (what the force-host-device simulation uses)
-            mesh_devices = np.asarray(devices).reshape(
-                (n_hosts,) + tuple(ici_shape)
-            )
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1,) + tuple(ici_shape),
+            dcn_mesh_shape=(n_hosts,) + (1,) * len(ici_shape),
+            devices=devices,
+            process_is_granule=True,
+        ).reshape((n_hosts,) + tuple(ici_shape))
     else:
-        mesh_devices = np.asarray(devices).reshape((1,) + tuple(ici_shape))
+        mesh_devices = np.asarray(devices).reshape(
+            (n_hosts,) + tuple(ici_shape)
+        )
     return Mesh(mesh_devices, (dcn_axis,) + tuple(ici_axes))
 
 

@@ -42,13 +42,20 @@ def _client_update(
 
 
 def make_round(
-    training_step: Callable, local_steps: int = 1
+    training_step: Callable,
+    local_steps: int = 1,
+    matmul_precision: str | None = None,
 ) -> Callable:
     """Build a jitted FedAvg round over a vmapped client axis.
 
     Returns ``round_fn(params, client_X [K,...], client_y [K,...], lr) ->
     (new_params, mean_loss, mean_acc)``. The new global params equal
     ``params - mean_k(diff_k)`` (reference cycle_manager.py:295-298).
+
+    ``matmul_precision``: an XLA dot precision name (e.g.
+    ``"BF16_BF16_F32"`` — single bf16 MXU pass with f32 accumulation,
+    ~5% faster than the default on v5e at MNIST-MLP sizes); None keeps
+    the platform default.
     """
 
     @jax.jit
@@ -60,10 +67,16 @@ def make_round(
             diffs = [p - n for p, n in zip(params, new_p)]
             return diffs, loss, acc
 
-        diffs, losses, accs = jax.vmap(one_client)(client_X, client_y)
-        avg_diff = [jnp.mean(d, axis=0) for d in diffs]
-        new_params = [p - d for p, d in zip(params, avg_diff)]
-        return new_params, jnp.mean(losses), jnp.mean(accs)
+        def body():
+            diffs, losses, accs = jax.vmap(one_client)(client_X, client_y)
+            avg_diff = [jnp.mean(d, axis=0) for d in diffs]
+            new_params = [p - d for p, d in zip(params, avg_diff)]
+            return new_params, jnp.mean(losses), jnp.mean(accs)
+
+        if matmul_precision is None:
+            return body()
+        with jax.default_matmul_precision(matmul_precision):
+            return body()
 
     return round_fn
 
