@@ -47,6 +47,19 @@ _ARRAY_OPS: dict[str, Callable] = {
     "argmax": jnp.argmax, "softmax": jax.nn.softmax,
 }
 
+# the ring-safe subset for int64/uint64 operands: numpy keeps 64-bit width
+# and wraps on overflow — exactly mod-2^64 share arithmetic
+_ARRAY_OPS_I64 = {
+    "__add__": np.add, "add": np.add,
+    "__sub__": np.subtract, "sub": np.subtract,
+    "__mul__": np.multiply, "mul": np.multiply,
+    "__matmul__": np.matmul, "matmul": np.matmul, "mm": np.matmul,
+    "__neg__": np.negative,
+    "sum": np.sum,
+    "t": lambda x: np.swapaxes(x, -1, -2),
+    "reshape": lambda x, *s, **k: np.reshape(x, s or k.get("shape")),
+}
+
 # per-type allowlists for method dispatch: everything else is rejected
 # (dunder like __setattr__ must never be remotely invokable)
 _METHOD_OPS: dict[type, set[str]] = {
@@ -232,6 +245,25 @@ class VirtualWorker:
                         f"{typ.__name__} does not support remote op {op!r}"
                     )
                 return getattr(first, op)(*args[1:], **kwargs)
+        # 64-bit integer arrays (SMPC ring shares travel as int64) must keep
+        # full width and wrap mod 2^64 — jnp would truncate to int32 under
+        # the default x64-off config, so they run on numpy instead. Only
+        # non-scalar operands count: Python int scalars arrive as 0-d int64
+        # and must not hijack float-tensor ops like ``ptr / 2``.
+        tensor_args = [
+            a for a in args if isinstance(a, np.ndarray) and a.ndim >= 1
+        ]
+        if tensor_args and all(
+            a.dtype.kind in "iu" and a.dtype.itemsize == 8
+            for a in tensor_args
+        ):
+            fn = _ARRAY_OPS_I64.get(op)
+            if fn is None:
+                raise E.PyGridError(
+                    f"op {op!r} not permitted on 64-bit integer tensors"
+                )
+            with np.errstate(over="ignore"):
+                return fn(*args, **kwargs)
         fn = _ARRAY_OPS.get(op)
         if fn is None:
             raise E.PyGridError(f"op {op!r} not permitted")

@@ -19,3 +19,8 @@ from pygrid_tpu.smpc.additive import (  # noqa: F401
     FixedPrecisionTensor,
     fix_prec,
 )
+from pygrid_tpu.smpc.remote import (  # noqa: F401
+    RemoteSharedTensor,
+    fix_prec_share_to_nodes,
+    share_to_nodes,
+)

@@ -1,0 +1,148 @@
+"""Distributed SMPC: shares living on real grid nodes.
+
+Parity surface: the reference's cross-node sharing flow
+(``x.fix_prec().share(alice, bob, charlie, dan)`` sends one share per Node
+over the WS binary path — SURVEY.md §3.4; host selection in chunks of 4,
+``apps/network/src/app/routes/network.py:16,98-131``).
+
+TPU-first split of responsibilities: heavy SMPC *compute* (Beaver
+mul/matmul over batches of parties) runs in the on-chip vmapped plane
+(:mod:`pygrid_tpu.smpc.kernels` / the Pallas matmul); this module covers
+the *protocol* plane — placing one additive share per real node, running
+the share-local linear algebra remotely via pointer ops (additive
+homomorphism: add/sub/public-scale never need communication), and
+reconstructing by opening every share. Shares travel and rest as int64
+(two's complement of the ring element); numpy's wrapping int64 arithmetic
+on the remote parties IS ring-2^64 arithmetic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from pygrid_tpu.smpc import ring as R
+from pygrid_tpu.smpc.additive import AdditiveSharingTensor
+from pygrid_tpu.smpc.fixed import FixedPointEncoder
+
+
+class RemoteSharedTensor:
+    """Handle to a secret whose additive shares live on remote nodes.
+
+    ``pointers[i]`` points at owner i's int64 share array. Linear ops are
+    share-local (one remote op per node, no cross-node traffic); ``get()``
+    opens the secret by fetching and summing all shares."""
+
+    def __init__(
+        self,
+        pointers: list,
+        encoder: FixedPointEncoder | None,
+    ) -> None:
+        self.pointers = list(pointers)
+        self.encoder = encoder
+
+    @property
+    def n_parties(self) -> int:
+        return len(self.pointers)
+
+    @property
+    def locations(self) -> list:
+        return [p.location for p in self.pointers]
+
+    # --- open ---------------------------------------------------------------
+
+    def get(self, delete: bool = True) -> np.ndarray:
+        """Fetch every share, sum in the ring, decode."""
+        shares = [
+            np.asarray(p.get(delete=delete)).astype(np.int64)
+            for p in self.pointers
+        ]
+        total = R.to_ring(sum_int64_wrapping(shares).astype(np.uint64))
+        if self.encoder:
+            return self.encoder.decode(total)
+        return R.from_ring_signed(total)
+
+    # --- share-local linear algebra (additive homomorphism) ----------------
+
+    def _party_ids(self) -> list:
+        return [getattr(p.location, "id", id(p.location)) for p in self.pointers]
+
+    def _zip_op(self, other: "RemoteSharedTensor", op: str):
+        if self._party_ids() != other._party_ids():
+            raise ValueError(
+                "operands are shared over different parties: "
+                f"{self._party_ids()} vs {other._party_ids()}"
+            )
+        mine, theirs = self.encoder, other.encoder
+        if (mine is None) != (theirs is None) or (
+            mine is not None and mine.scale != theirs.scale
+        ):
+            raise ValueError("mismatched fixed-point encoders")
+        ptrs = [
+            getattr(a, op)(b)
+            for a, b in zip(self.pointers, other.pointers)
+        ]
+        return RemoteSharedTensor(ptrs, self.encoder)
+
+    def __add__(self, other: "RemoteSharedTensor") -> "RemoteSharedTensor":
+        return self._zip_op(other, "__add__")
+
+    def __sub__(self, other: "RemoteSharedTensor") -> "RemoteSharedTensor":
+        return self._zip_op(other, "__sub__")
+
+    def mul_public(self, c: int) -> "RemoteSharedTensor":
+        """Multiply by a public integer (share-local; no rescale, so for
+        fixed-point secrets ``c`` must be an integer scalar)."""
+        if not float(c).is_integer():
+            raise ValueError("public factor must be an integer")
+        ptrs = [p * np.int64(int(c)) for p in self.pointers]
+        return RemoteSharedTensor(ptrs, self.encoder)
+
+    def __repr__(self) -> str:
+        locs = [getattr(loc, "id", loc) for loc in self.locations]
+        return f"RemoteSharedTensor(parties={locs})"
+
+
+def sum_int64_wrapping(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Ring sum of int64 share arrays (numpy wraps on overflow — exactly
+    the mod-2^64 semantics the shares need)."""
+    with np.errstate(over="ignore"):
+        total = arrays[0].copy()
+        for a in arrays[1:]:
+            total += a
+    return total
+
+
+def share_to_nodes(
+    x: np.ndarray,
+    clients: Sequence[Any],
+    encoder: FixedPointEncoder | None = None,
+    tags: Sequence[str] = (),
+) -> RemoteSharedTensor:
+    """Split ``x`` into len(clients) additive shares, one per node.
+
+    ``clients``: DataCentricFLClient-like locations (anything pointers can
+    ``send`` through). Mirrors the reference's
+    ``x.fix_prec().share(*nodes)``."""
+    owners = [getattr(c, "id", str(i)) for i, c in enumerate(clients)]
+    ast = AdditiveSharingTensor.share(
+        np.asarray(x), owners, encoder=encoder
+    )
+    share_arrays = R.from_ring(ast.shares).astype(np.int64)  # [P, ...]
+    pointers = []
+    for i, client in enumerate(clients):
+        pointers.append(client.send(share_arrays[i], tags=set(tags)))
+    return RemoteSharedTensor(pointers, encoder)
+
+
+def fix_prec_share_to_nodes(
+    x: np.ndarray,
+    clients: Sequence[Any],
+    base: int = 10,
+    precision_fractional: int = 3,
+    tags: Sequence[str] = (),
+) -> RemoteSharedTensor:
+    """``x.fix_prec().share(alice, bob, …)`` over real nodes."""
+    encoder = FixedPointEncoder(base, precision_fractional)
+    return share_to_nodes(x, clients, encoder=encoder, tags=tags)

@@ -251,3 +251,44 @@ def test_unknown_op_rejected(alice):
 def test_missing_object(alice):
     with pytest.raises(ObjectNotFoundError):
         PointerTensor(alice, 424242).get()
+
+
+def test_remote_int64_ops_keep_full_width():
+    """Regression: 64-bit integer remote ops must not truncate to int32
+    (jnp's x64-off default) — ring shares and any int64 user data depend
+    on full-width wrapping arithmetic."""
+    import numpy as np
+
+    from pygrid_tpu.runtime.pointers import send
+    from pygrid_tpu.runtime.worker import VirtualWorker
+
+    w = VirtualWorker(id="i64")
+    a = np.array([2**62 + 12345, -17], dtype=np.int64)
+    b = np.array([2**62 + 1, 23], dtype=np.int64)
+    pa, pb = send(a, w), send(b, w)
+    out = np.asarray((pa + pb).get())
+    assert out.dtype == np.int64
+    with np.errstate(over="ignore"):
+        np.testing.assert_array_equal(out, a + b)  # wraps mod 2^64
+    m = np.array([[3, 1], [2, 5]], dtype=np.int64)
+    pm = send(m, w)
+    got = np.asarray((pm @ pm).get())
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got, m @ m)
+
+
+def test_float_tensor_scalar_ops_not_hijacked_by_i64_path():
+    """Regression: a Python int scalar (0-d int64 on the wire) must not
+    route float-tensor ops onto the numpy int64 path — ``ptr / 2`` stays a
+    float op."""
+    import numpy as np
+
+    from pygrid_tpu.runtime.pointers import send
+    from pygrid_tpu.runtime.worker import VirtualWorker
+
+    w = VirtualWorker(id="fs")
+    p = send(np.array([2.0, 4.0], dtype=np.float32), w)
+    np.testing.assert_allclose(np.asarray((p / 2).get()), [1.0, 2.0])
+    np.testing.assert_allclose(
+        np.asarray((p * 2).get(delete=False)), [4.0, 8.0]
+    )
