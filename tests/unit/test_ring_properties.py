@@ -1,0 +1,128 @@
+"""Property-based tests: ring-2^64 limb arithmetic vs numpy uint64 truth.
+
+The reference ships no property-based tests (SURVEY.md §4); the ring layer
+is exactly where they pay off — every op must agree with numpy's native
+mod-2^64 arithmetic on adversarial values (carry boundaries, sign
+boundaries, zeros)."""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from pygrid_tpu.smpc import ring as R
+
+U64_EDGES = [
+    0, 1, 2, 2**31 - 1, 2**31, 2**32 - 1, 2**32, 2**33,
+    2**62, 2**63 - 1, 2**63, 2**64 - 2, 2**64 - 1,
+]
+
+u64 = st.one_of(
+    st.sampled_from(U64_EDGES),
+    st.integers(min_value=0, max_value=2**64 - 1),
+)
+u64_arrays = st.lists(u64, min_size=1, max_size=16).map(
+    lambda v: np.array(v, dtype=np.uint64)
+)
+pairs = st.lists(
+    st.tuples(u64, u64), min_size=1, max_size=16
+).map(
+    lambda v: (
+        np.array([a for a, _ in v], dtype=np.uint64),
+        np.array([b for _, b in v], dtype=np.uint64),
+    )
+)
+
+
+def _np(r: R.Ring64) -> np.ndarray:
+    return R.from_ring(r)
+
+
+@settings(max_examples=200, deadline=None)
+@given(pairs)
+def test_add_matches_numpy(ab):
+    a, b = ab
+    with np.errstate(over="ignore"):
+        want = a + b
+    np.testing.assert_array_equal(
+        _np(R.ring_add(R.to_ring(a), R.to_ring(b))), want
+    )
+
+
+@settings(max_examples=200, deadline=None)
+@given(pairs)
+def test_sub_matches_numpy(ab):
+    a, b = ab
+    with np.errstate(over="ignore"):
+        want = a - b
+    np.testing.assert_array_equal(
+        _np(R.ring_sub(R.to_ring(a), R.to_ring(b))), want
+    )
+
+
+@settings(max_examples=200, deadline=None)
+@given(pairs)
+def test_mul_matches_numpy(ab):
+    a, b = ab
+    with np.errstate(over="ignore"):
+        want = a * b
+    np.testing.assert_array_equal(
+        _np(R.ring_mul(R.to_ring(a), R.to_ring(b))), want
+    )
+
+
+@settings(max_examples=200, deadline=None)
+@given(u64_arrays)
+def test_neg_is_additive_inverse(a):
+    ra = R.to_ring(a)
+    total = R.ring_add(ra, R.ring_neg(ra))
+    np.testing.assert_array_equal(_np(total), np.zeros_like(a))
+
+
+@settings(max_examples=100, deadline=None)
+@given(u64_arrays, st.integers(min_value=1, max_value=2**16 - 1))
+def test_div_const_matches_numpy(a, d):
+    want = a // np.uint64(d)
+    np.testing.assert_array_equal(
+        _np(R.ring_div_const(R.to_ring(a), d)), want
+    )
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    st.integers(min_value=1, max_value=6),
+    st.integers(min_value=1, max_value=24),
+    st.integers(min_value=1, max_value=6),
+    st.integers(min_value=0, max_value=2**32),
+)
+def test_matmul_matches_numpy(m, k, n, seed):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, 2**64, size=(m, k), dtype=np.uint64)
+    b = rng.integers(0, 2**64, size=(k, n), dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        want = (a[:, :, None] * b[None, :, :]).sum(axis=1)
+    np.testing.assert_array_equal(
+        _np(R.ring_matmul(R.to_ring(a), R.to_ring(b))), want
+    )
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.lists(
+        st.floats(
+            min_value=-1e12, max_value=1e12,
+            allow_nan=False, allow_infinity=False,
+        ),
+        min_size=1, max_size=8,
+    )
+)
+def test_fixed_point_roundtrip(values):
+    from pygrid_tpu.smpc.fixed import FixedPointEncoder
+
+    enc = FixedPointEncoder()
+    x = np.array(values)
+    back = enc.decode(enc.encode(x))
+    # atol: half a quantization step; rtol: float64 ulp at |x|·scale ~ 1e15
+    np.testing.assert_allclose(
+        back, x, atol=0.5 / enc.scale * 1.01, rtol=1e-12
+    )
