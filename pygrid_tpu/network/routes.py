@@ -260,7 +260,37 @@ async def nodes_status(request: web.Request) -> web.Response:
     )
 
 
+def _rbac_twin(event_type: str):
+    """HTTP twin of a user/role/group WS event (same pattern as the Node's
+    ``_ws_twin`` — reference serves both surfaces per app)."""
+    from pygrid_tpu.users.events import USER_HANDLERS
+
+    async def handler(request: web.Request) -> web.Response:
+        ctx = _ctx(request)
+        try:
+            body = (
+                json.loads(await request.text())
+                if request.can_read_body
+                else {}
+            )
+        except json.JSONDecodeError as err:
+            return web.json_response({"error": str(err)}, status=400)
+        token = request.headers.get("token")
+        if token and "token" not in body:
+            body["token"] = token
+        body.update(
+            {k: v for k, v in request.match_info.items() if k not in body}
+        )
+        response = USER_HANDLERS[event_type](ctx, {"data": body})
+        status = 200 if "error" not in response else 400
+        return web.json_response(response, status=status)
+
+    return handler
+
+
 def register(app: web.Application) -> None:
+    from pygrid_tpu.utils.codes import ROLE_EVENTS, USER_EVENTS
+
     r = app.router
     r.add_post("/join", join)
     r.add_get("/connected-nodes", connected_nodes)
@@ -275,3 +305,16 @@ def register(app: web.Application) -> None:
     r.add_get("/models", models)
     r.add_get("/datasets", datasets)
     r.add_get("/nodes-status", nodes_status)
+    r.add_post("/users/signup", _rbac_twin(USER_EVENTS.SIGNUP_USER))
+    r.add_post("/users/login", _rbac_twin(USER_EVENTS.LOGIN_USER))
+    r.add_get("/users/", _rbac_twin(USER_EVENTS.GET_ALL_USERS))
+    r.add_get("/users/{id}", _rbac_twin(USER_EVENTS.GET_SPECIFIC_USER))
+    r.add_put("/users/{id}/email", _rbac_twin(USER_EVENTS.PUT_EMAIL))
+    r.add_put("/users/{id}/password", _rbac_twin(USER_EVENTS.PUT_PASSWORD))
+    r.add_put("/users/{id}/role", _rbac_twin(USER_EVENTS.PUT_ROLE))
+    r.add_delete("/users/{id}", _rbac_twin(USER_EVENTS.DELETE_USER))
+    r.add_post("/roles/", _rbac_twin(ROLE_EVENTS.CREATE_ROLE))
+    r.add_get("/roles/", _rbac_twin(ROLE_EVENTS.GET_ALL_ROLES))
+    r.add_get("/roles/{id}", _rbac_twin(ROLE_EVENTS.GET_ROLE))
+    r.add_put("/roles/{id}", _rbac_twin(ROLE_EVENTS.PUT_ROLE))
+    r.add_delete("/roles/{id}", _rbac_twin(ROLE_EVENTS.DELETE_ROLE))

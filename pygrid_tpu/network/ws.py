@@ -24,6 +24,13 @@ async def _handle(ctx: NetworkContext, message: dict, ws) -> dict | None:
     msg_type = message.get("type")
     data = message.get("data") or message
 
+    # user/role/group WS twins — same table the Node serves (reference
+    # apps/network/src/app/events/__init__.py:12-30)
+    from pygrid_tpu.users.events import USER_HANDLERS
+
+    if msg_type in USER_HANDLERS:
+        return USER_HANDLERS[msg_type](ctx, message)
+
     if msg_type == "join":
         node_id = data.get("node-id") or data.get("id")
         address = data.get("node-address") or data.get("address")

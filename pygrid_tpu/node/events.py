@@ -15,7 +15,6 @@ import base64
 import binascii
 import logging
 import uuid
-from dataclasses import asdict
 from typing import Any, Callable
 
 from pygrid_tpu.datacentric.object_storage import recover_objects
@@ -23,16 +22,14 @@ from pygrid_tpu.federated.auth import verify_token
 from pygrid_tpu.node import NodeContext, __version__
 from pygrid_tpu.node.sockets import SocketHandler
 from pygrid_tpu.serde import deserialize, serialize
+from pygrid_tpu.users.events import USER_HANDLERS
 from pygrid_tpu.utils import exceptions as E
 from pygrid_tpu.utils.codes import (
     CONTROL_EVENTS,
     CYCLE,
-    GROUP_EVENTS,
     MODEL_CENTRIC_FL_EVENTS,
     MSG_FIELD,
     REQUEST_MSG,
-    ROLE_EVENTS,
-    USER_EVENTS,
 )
 
 logger = logging.getLogger(__name__)
@@ -364,134 +361,10 @@ def run_inference(ctx: NodeContext, message: dict, conn: Connection) -> dict:
 
 
 # ── user / role / group WS twins (reference {user,role,group}_related.py) ────
+# handlers live in pygrid_tpu.users.events so the Network app serves the
+# identical RBAC surface (the reference duplicates them per app)
 
-
-def _serializable(obj: Any) -> Any:
-    if hasattr(obj, "__dataclass_fields__"):
-        d = asdict(obj)
-        d.pop("hashed_password", None)
-        d.pop("salt", None)
-        d.pop("private_key", None)
-        return d
-    return obj
-
-
-def _user_op(fn: Callable) -> Callable:
-    """Wrap a UserManager call: resolve the token, format the response."""
-
-    def wrapper(ctx: NodeContext, message: dict, conn: Connection) -> dict:
-        data = message.get(MSG_FIELD.DATA) or message
-        try:
-            current = ctx.users.resolve_token(data.get("token"))
-            result = fn(ctx, current, data)
-            if isinstance(result, list):
-                result = [_serializable(r) for r in result]
-            else:
-                result = _serializable(result)
-            return {CYCLE.STATUS: SUCCESS, MSG_FIELD.DATA: result}
-        except E.PyGridError as err:
-            return {ERROR: str(err)}
-
-    return wrapper
-
-
-def signup_user(ctx: NodeContext, message: dict, conn: Connection) -> dict:
-    data = message.get(MSG_FIELD.DATA) or message
-    try:
-        user = ctx.users.signup(
-            data.get("email"),
-            data.get("password"),
-            role=data.get("role"),
-            private_key=data.get("private-key"),
-        )
-        return {CYCLE.STATUS: SUCCESS, "user": _serializable(user)}
-    except E.PyGridError as err:
-        return {ERROR: str(err)}
-
-
-def login_user(ctx: NodeContext, message: dict, conn: Connection) -> dict:
-    data = message.get(MSG_FIELD.DATA) or message
-    try:
-        token = ctx.users.login(
-            data.get("email"),
-            data.get("password"),
-            private_key=data.get("private-key"),
-        )
-        return {CYCLE.STATUS: SUCCESS, "token": token}
-    except E.PyGridError as err:
-        return {ERROR: str(err)}
-
-
-_USER_HANDLERS = {
-    USER_EVENTS.SIGNUP_USER: signup_user,
-    USER_EVENTS.LOGIN_USER: login_user,
-    USER_EVENTS.GET_ALL_USERS: _user_op(
-        lambda ctx, cur, d: ctx.users.get_all_users(cur)
-    ),
-    USER_EVENTS.GET_SPECIFIC_USER: _user_op(
-        lambda ctx, cur, d: ctx.users.get_user(cur, int(d["id"]))
-    ),
-    USER_EVENTS.SEARCH_USERS: _user_op(
-        lambda ctx, cur, d: ctx.users.search_users(
-            cur, **{k: v for k, v in d.items() if k in ("email", "role")}
-        )
-    ),
-    USER_EVENTS.PUT_EMAIL: _user_op(
-        lambda ctx, cur, d: ctx.users.change_email(cur, int(d["id"]), d["email"])
-    ),
-    USER_EVENTS.PUT_PASSWORD: _user_op(
-        lambda ctx, cur, d: ctx.users.change_password(
-            cur, int(d["id"]), d["password"]
-        )
-    ),
-    USER_EVENTS.PUT_ROLE: _user_op(
-        lambda ctx, cur, d: ctx.users.change_role(cur, int(d["id"]), d["role"])
-    ),
-    USER_EVENTS.PUT_GROUPS: _user_op(
-        lambda ctx, cur, d: ctx.users.change_groups(
-            cur, int(d["id"]), d["groups"]
-        )
-    ),
-    USER_EVENTS.DELETE_USER: _user_op(
-        lambda ctx, cur, d: ctx.users.delete_user(cur, int(d["id"]))
-    ),
-    ROLE_EVENTS.CREATE_ROLE: _user_op(
-        lambda ctx, cur, d: ctx.users.create_role(
-            cur, **{k: v for k, v in d.items() if k != "token"}
-        )
-    ),
-    ROLE_EVENTS.GET_ROLE: _user_op(
-        lambda ctx, cur, d: ctx.users.get_role(cur, int(d["id"]))
-    ),
-    ROLE_EVENTS.GET_ALL_ROLES: _user_op(
-        lambda ctx, cur, d: ctx.users.get_all_roles(cur)
-    ),
-    ROLE_EVENTS.PUT_ROLE: _user_op(
-        lambda ctx, cur, d: ctx.users.put_role(
-            cur, int(d["id"]), **{k: v for k, v in d.items() if k not in ("token", "id")}
-        )
-    ),
-    ROLE_EVENTS.DELETE_ROLE: _user_op(
-        lambda ctx, cur, d: ctx.users.delete_role(cur, int(d["id"]))
-    ),
-    GROUP_EVENTS.CREATE_GROUP: _user_op(
-        lambda ctx, cur, d: ctx.users.create_group(cur, d["name"])
-    ),
-    GROUP_EVENTS.GET_GROUP: _user_op(
-        lambda ctx, cur, d: ctx.users.get_group(cur, int(d["id"]))
-    ),
-    GROUP_EVENTS.GET_ALL_GROUPS: _user_op(
-        lambda ctx, cur, d: ctx.users.get_all_groups(cur)
-    ),
-    GROUP_EVENTS.PUT_GROUP: _user_op(
-        lambda ctx, cur, d: ctx.users.put_group(
-            cur, int(d["id"]), **{k: v for k, v in d.items() if k not in ("token", "id")}
-        )
-    ),
-    GROUP_EVENTS.DELETE_GROUP: _user_op(
-        lambda ctx, cur, d: ctx.users.delete_group(cur, int(d["id"]))
-    ),
-}
+_USER_HANDLERS = USER_HANDLERS
 
 # ── dispatch ─────────────────────────────────────────────────────────────────
 

@@ -188,3 +188,41 @@ def test_monitor_propagates_node_location(grid, monkeypatch):
             return
         time.sleep(0.3)
     pytest.fail(f"location never propagated: {statuses}")
+
+
+def test_network_rbac_http_twins(grid):
+    """Network serves the same users/roles surface as the Node (reference
+    apps/network RBAC — bcrypt+JWT, first user auto-Owner)."""
+    r = requests.post(
+        grid.network_url + "/users/signup",
+        json={"email": "net-admin@example.com", "password": "pw123456"},
+        timeout=10,
+    )
+    assert r.status_code == 200, r.text
+    assert r.json()["user"]["email"] == "net-admin@example.com"
+
+    r = requests.post(
+        grid.network_url + "/users/login",
+        json={"email": "net-admin@example.com", "password": "pw123456"},
+        timeout=10,
+    )
+    token = r.json()["token"]
+    assert token
+
+    r = requests.get(
+        grid.network_url + "/users/", headers={"token": token}, timeout=10
+    )
+    assert r.status_code == 200
+    emails = [u["email"] for u in r.json()["data"]]
+    assert "net-admin@example.com" in emails
+
+    r = requests.get(
+        grid.network_url + "/roles/", headers={"token": token}, timeout=10
+    )
+    assert r.status_code == 200 and len(r.json()["data"]) >= 2
+
+    # bad token rejected
+    r = requests.get(
+        grid.network_url + "/users/", headers={"token": "junk"}, timeout=10
+    )
+    assert r.status_code == 400
