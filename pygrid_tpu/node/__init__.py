@@ -44,6 +44,7 @@ class NodeContext:
         secret_key: str | None = None,
         network_url: str | None = None,
         num_replicas: int | None = None,
+        strict_crypto_store: bool = False,
     ) -> None:
         self.id = node_id
         self.address: str | None = None
@@ -60,6 +61,16 @@ class NodeContext:
         # the Node's singleton party (reference local_worker)
         self.local_worker = VirtualWorker(id=node_id)
         set_persistent_mode(self.local_worker, self.kv)
+        # every node can act as a cross-node triple dealer (the reference's
+        # crypto-provider worker, e.g. james in
+        # test_basic_syft_operations.py:455-491); strict mode reproduces
+        # the EmptyCryptoPrimitiveStoreError refill round-trip
+        from pygrid_tpu.smpc.provider import CryptoProvider
+
+        self.crypto_provider = CryptoProvider(
+            id=f"{node_id}-crypto", strict_store=strict_crypto_store
+        )
+        self.local_worker.crypto_provider = self.crypto_provider
 
         self.fl = FLController(self.db)
         self.models = ModelController(self.kv)
@@ -85,6 +96,7 @@ def create_app(
     secret_key: str | None = None,
     network_url: str | None = None,
     num_replicas: int | None = None,
+    strict_crypto_store: bool = False,
 ):
     """Build the aiohttp application (reference create_app, __init__.py:131)."""
     from aiohttp import web
@@ -99,6 +111,7 @@ def create_app(
         secret_key=secret_key,
         network_url=network_url,
         num_replicas=num_replicas,
+        strict_crypto_store=strict_crypto_store,
     )
     app = web.Application(client_max_size=256 * 1024 * 1024)
     app["node"] = ctx

@@ -243,6 +243,8 @@ def authentication(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     # grid peers dialed before this login become reachable from this session
     for peer_id, peer in ctx.local_worker._known_workers.items():
         session.worker._known_workers.setdefault(peer_id, peer)
+    # session workers answer crypto-deal requests with the node's dealer
+    session.worker.crypto_provider = ctx.crypto_provider
     return {SUCCESS: "True", MSG_FIELD.NODE_ID: session.worker.id, "token": token}
 
 

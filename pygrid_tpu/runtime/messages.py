@@ -113,6 +113,50 @@ class GetShapeMessage:
 
 @_simple_serde
 @dataclass
+class CryptoRequestMessage:
+    """Ask a crypto-provider worker to deal one primitive to the parties.
+
+    ``op`` ∈ {"mul", "matmul", "trunc"}. For triples, ``shape_x``/``shape_y``
+    are the operand shapes; for a truncation pair, ``shape_x`` is the value
+    shape and ``shape_y`` carries ``[scale]``. The provider pushes each
+    party's share arrays to the named party workers (its known-worker mesh)
+    and answers with the stored object ids (:class:`CryptoDealResponse`).
+    A strict-store provider with no stocked primitive raises
+    ``EmptyCryptoPrimitiveStoreError`` — the refill round-trip the reference
+    serializes over the wire (reference syft_events.py:34-45).
+    """
+
+    op: str
+    shape_x: list[int]
+    shape_y: list[int]
+    party_ids: list[str] = field(default_factory=list)
+
+
+@_simple_serde
+@dataclass
+class CryptoProvideMessage:
+    """Refill the provider's primitive store (response to an empty-store
+    error; mirrors syft's ``provide_primitives`` round)."""
+
+    op: str
+    shape_x: list[int]
+    shape_y: list[int]
+    n_parties: int
+    n_instances: int = 1
+
+
+@_simple_serde
+@dataclass
+class CryptoDealResponse:
+    """Ids of the dealt share objects: ``ids[i]`` lists party i's object ids
+    (one per component — [a,b,c] for a triple, [r,r'] for a trunc pair)."""
+
+    party_ids: list[str]
+    ids: list[list[int]] = field(default_factory=list)
+
+
+@_simple_serde
+@dataclass
 class ErrorResponse:
     error_type: str
     message: str = ""

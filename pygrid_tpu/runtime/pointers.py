@@ -179,11 +179,10 @@ def send(
         user=user,
     )
     resp = _raise_if_error(resp)
-    ptr = PointerTensor(
+    return PointerTensor(
         location=location,
         id_at_location=resp.id_at_location,
         shape=resp.shape,
         tags=tags,
         owner_user=user,
     )
-    return ptr

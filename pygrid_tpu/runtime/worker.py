@@ -75,6 +75,10 @@ class VirtualWorker:
         self.id = str(id)
         self.store = ObjectStore(self.id)
         self._known_workers: dict[str, "VirtualWorker"] = {}
+        #: set to a CryptoProvider to make this worker a triple dealer
+        #: (the reference's crypto-provider node, e.g. "james" in
+        #: test_basic_syft_operations.py:455-491)
+        self.crypto_provider = None
         self._message_router: dict[type, Callable] = {
             M.ObjectMessage: self._handle_object,
             M.ObjectRequestMessage: self._handle_object_request,
@@ -84,6 +88,8 @@ class VirtualWorker:
             M.SearchMessage: self._handle_search,
             M.IsNoneMessage: self._handle_is_none,
             M.GetShapeMessage: self._handle_shape,
+            M.CryptoRequestMessage: self._handle_crypto_request,
+            M.CryptoProvideMessage: self._handle_crypto_provide,
         }
 
     # --- mesh ---------------------------------------------------------------
@@ -289,6 +295,83 @@ class VirtualWorker:
             location=self.id,
             shape=list(getattr(result, "shape", ()) or ()),
         )
+
+    # --- crypto-provider plane (cross-node Beaver dealing) -------------------
+
+    def _require_provider(self):
+        if self.crypto_provider is None:
+            raise E.PyGridError(f"worker {self.id!r} is not a crypto provider")
+        return self.crypto_provider
+
+    def _handle_crypto_request(self, msg: M.CryptoRequestMessage, user: str | None):
+        """Deal one primitive: generate (or pop from the strict store — may
+        raise ``EmptyCryptoPrimitiveStoreError``, which ``_recv_msg``
+        serializes back with the refill kwargs), then push each party's
+        share arrays to the party workers over the known-worker mesh."""
+        from pygrid_tpu.smpc import ring as R
+
+        provider = self._require_provider()
+        n = len(msg.party_ids)
+        if n < 2:
+            raise E.PyGridError("need at least 2 parties")
+        # resolve every target BEFORE drawing the primitive: a bad party id
+        # must not consume strict-store stock
+        targets = []
+        for pid in msg.party_ids:
+            target = self if pid == self.id else self._known_workers.get(pid)
+            if target is None:
+                raise E.WorkerNotFoundError(f"unknown party worker {pid!r}")
+            targets.append(target)
+        if msg.op == "trunc":
+            components = provider.trunc_pair(
+                tuple(msg.shape_x), int(msg.shape_y[0]), n
+            )
+        else:
+            components = provider.triple(
+                msg.op, tuple(msg.shape_x), tuple(msg.shape_y), n
+            )
+        ids: list[list[int]] = []
+        pushed: list[tuple[Any, int]] = []  # (target, obj_id) for rollback
+        try:
+            for i, target in enumerate(targets):
+                row = []
+                for stacked in components:
+                    # wire format: one party's slice as int64 (two's complement)
+                    arr = R.from_ring(
+                        R.Ring64(stacked.lo[i], stacked.hi[i])
+                    ).astype(np.int64)
+                    resp = target.recv_obj_msg(
+                        M.ObjectMessage(obj=arr), user=user
+                    )
+                    if isinstance(resp, M.ErrorResponse):
+                        raise E.PyGridError(
+                            f"dealing to {msg.party_ids[i]!r} failed: "
+                            f"{resp.message}"
+                        )
+                    row.append(resp.id_at_location)
+                    pushed.append((target, resp.id_at_location))
+                ids.append(row)
+        except Exception:
+            for target, obj_id in pushed:  # best-effort: no orphaned shares
+                try:
+                    target.recv_obj_msg(
+                        M.ForceObjectDeleteMessage(obj_id=obj_id), user=user
+                    )
+                except Exception:  # noqa: BLE001 — cleanup path
+                    pass
+            raise
+        return M.CryptoDealResponse(party_ids=list(msg.party_ids), ids=ids)
+
+    def _handle_crypto_provide(self, msg: M.CryptoProvideMessage, user: str | None):
+        provider = self._require_provider()
+        provider.provide(
+            msg.op,
+            tuple(msg.shape_x),
+            tuple(msg.shape_y),
+            msg.n_parties,
+            msg.n_instances,
+        )
+        return {"status": "ok"}
 
     @staticmethod
     def _visible_to(obj: StoredObject, user: str | None) -> bool:

@@ -20,6 +20,7 @@ from pygrid_tpu.smpc.additive import (  # noqa: F401
     fix_prec,
 )
 from pygrid_tpu.smpc.remote import (  # noqa: F401
+    RemoteCryptoProvider,
     RemoteSharedTensor,
     fix_prec_share_to_nodes,
     share_to_nodes,

@@ -147,7 +147,7 @@ class AdditiveSharingTensor:
 
     def _beaver(self, other: "AdditiveSharingTensor", op: str):
         """Beaver protocol round — delegates to the stacked XLA kernel."""
-        from pygrid_tpu.smpc.kernels import beaver_combine
+        from pygrid_tpu.smpc.kernels import beaver_combine, masked_truncate
 
         self._check_compat(other)
         provider = self._provider()
@@ -155,7 +155,13 @@ class AdditiveSharingTensor:
         a_sh, b_sh, c_sh = provider.triple(op, self.shape, other.shape, n)
         z = beaver_combine(self.shares, other.shares, a_sh, b_sh, c_sh, op)
         if self.encoder:  # product carries scale^2 — rescale once
-            z = provider.reshare_truncated(z, self.encoder.scale, n)
+            if provider.trusted_dealer:
+                z = provider.reshare_truncated(z, self.encoder.scale, n)
+            else:
+                r_sh, rp_sh = provider.trunc_pair(
+                    z.shape[1:], self.encoder.scale, n
+                )
+                z = masked_truncate(z, r_sh, rp_sh, self.encoder.scale)
         return self._like(z)
 
     def __mul__(self, other):

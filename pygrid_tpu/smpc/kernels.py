@@ -74,6 +74,50 @@ def beaver_combine(
     return R.Ring64(z.lo.at[0].set(z0.lo), z.hi.at[0].set(z0.hi))
 
 
+#: mask-and-open truncation offset magnitude: the secret product z must
+#: satisfy |z| < scale * 2^OFFSET_BITS, i.e. |x·y| < 2^OFFSET_BITS / scale
+OFFSET_BITS = 30
+
+
+def masked_truncate(
+    z_sh: R.Ring64, r_sh: R.Ring64, rp_sh: R.Ring64, scale: int
+) -> R.Ring64:
+    """Rescale product shares by ``scale`` without anyone seeing the secret.
+
+    Mask-and-open truncation with a dealer-provided pair
+    (``r`` uniform < 2^62, ``r' = floor(r/scale)``):
+
+    1. open ``m = z + OFFSET + r``  (OFFSET = scale·2^30 keeps the sum
+       positive; m < 2^63 so the ring sum is the exact integer sum);
+    2. publicly compute ``q = floor(m / scale)``;
+    3. output shares: party 0 holds ``q − 2^30 − r'_0``, party i>0 holds
+       ``−r'_i``  →  the shares sum to ``floor(z/scale) + ε``, ε ∈ {0, 1}.
+
+    Nobody learns z: parties only ever see their own shares, and the opened
+    ``m`` is statistically masked by r (distance ≈ 2^(log2(scale)+31−62)).
+    Compare the dealer-sees-all alternative
+    :meth:`~pygrid_tpu.smpc.provider.CryptoProvider.reshare_truncated`,
+    which reconstructs z at the dealer (reference-faithful exactness, kept
+    behind ``trusted_dealer=True``).
+    """
+    import numpy as np
+
+    offset = R.to_ring(np.uint64(scale) << np.uint64(OFFSET_BITS))
+    m_sh = R.ring_add(z_sh, r_sh)
+    m0 = R.ring_add(R.Ring64(m_sh.lo[0], m_sh.hi[0]), offset)
+    m_sh = R.Ring64(m_sh.lo.at[0].set(m0.lo), m_sh.hi.at[0].set(m0.hi))
+    m = reconstruct_kernel(m_sh)  # public masked value, < 2^63
+    q = R.ring_div_const(m, scale)
+    out = _party_map(R.ring_neg, rp_sh)  # party i: −r'_i
+    head = R.ring_add(
+        R.Ring64(out.lo[0], out.hi[0]),
+        R.ring_sub(q, R.to_ring(np.uint64(1) << np.uint64(OFFSET_BITS))),
+    )
+    return R.Ring64(
+        out.lo.at[0].set(head.lo), out.hi.at[0].set(head.hi)
+    )
+
+
 @partial(jax.jit, static_argnames=("op", "n_parties"))
 def batched_beaver(
     key: jax.Array,

@@ -65,10 +65,16 @@ class CryptoProvider:
         id: str = "crypto_provider",
         seed: int | None = None,
         strict_store: bool = False,
+        trusted_dealer: bool = False,
     ) -> None:
         self.id = id
         self.store = CryptoStore()
         self.strict_store = strict_store
+        #: opt-in to the dealer-sees-all exact truncation
+        #: (:meth:`reshare_truncated`); the default rescale path is the
+        #: mask-and-open protocol built on :meth:`trunc_pair`, in which the
+        #: dealer never reconstructs a secret
+        self.trusted_dealer = trusted_dealer
         if seed is None:
             # triple secrecy rests on this randomness: a fixed default seed
             # would make every dealer's a/b stream publicly reproducible and
@@ -76,9 +82,15 @@ class CryptoProvider:
             import secrets
 
             seed = secrets.randbits(63)
-        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+        # lazy: creating a PRNGKey initializes the jax backend, and a node
+        # server must not dial the accelerator just to exist — only the
+        # first dealt primitive pays for backend init
+        self._key: jax.Array | None = None
 
     def _next_key(self) -> jax.Array:
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 
@@ -102,14 +114,44 @@ class CryptoProvider:
             share_kernel(ksc, c, n_parties),
         )
 
+    def _make_trunc_pair(
+        self, shape: tuple, scale: int, n_parties: int
+    ) -> tuple[R.Ring64, R.Ring64]:
+        """A truncation pair: shares of ``r`` uniform in [0, 2^62) and of
+        ``r' = floor(r / scale)`` — the preprocessed randomness for
+        mask-and-open truncation (see :func:`pygrid_tpu.smpc.kernels.masked_truncate`).
+        """
+        import jax.numpy as jnp
+
+        kr, ks1, ks2 = jax.random.split(self._next_key(), 3)
+        r = R.ring_random(kr, tuple(shape))
+        # clear the top 2 bits: r < 2^62 guarantees the masked open
+        # z + OFFSET + r never wraps mod 2^64
+        r = R.Ring64(r.lo, r.hi & jnp.uint32(0x3FFFFFFF))
+        r_prime = R.ring_div_const(r, scale)
+        return (
+            share_kernel(ks1, r, n_parties),
+            share_kernel(ks2, r_prime, n_parties),
+        )
+
     def provide(
         self, op: str, shape_x: tuple, shape_y: tuple, n_parties: int,
         n_instances: int = 1,
     ) -> None:
-        """Refill the store (the response to an empty-store error)."""
+        """Refill the store (the response to an empty-store error).
+
+        ``op="trunc"`` refills truncation pairs: ``shape_x`` is the value
+        shape and ``shape_y`` carries ``(scale,)``.
+        """
         key = CryptoStore.key(op, shape_x, shape_y, n_parties)
         for _ in range(n_instances):
-            self.store.put(key, self._make_triple(op, shape_x, shape_y, n_parties))
+            if op == "trunc":
+                item = self._make_trunc_pair(
+                    tuple(shape_x), int(shape_y[0]), n_parties
+                )
+            else:
+                item = self._make_triple(op, shape_x, shape_y, n_parties)
+            self.store.put(key, item)
 
     def triple(
         self, op: str, shape_x: tuple, shape_y: tuple, n_parties: int
@@ -120,6 +162,17 @@ class CryptoProvider:
         if self.strict_store:
             return self.store.pop(key)  # raises EmptyCryptoPrimitiveStoreError
         return self._make_triple(op, shape_x, shape_y, n_parties)
+
+    def trunc_pair(
+        self, shape: tuple, scale: int, n_parties: int
+    ) -> tuple[R.Ring64, R.Ring64]:
+        """Draw (or generate) one truncation pair for ``shape``/``scale``."""
+        key = CryptoStore.key("trunc", tuple(shape), (int(scale),), n_parties)
+        if self.store.count(key):
+            return self.store.pop(key)
+        if self.strict_store:
+            return self.store.pop(key)  # raises EmptyCryptoPrimitiveStoreError
+        return self._make_trunc_pair(tuple(shape), int(scale), n_parties)
 
     # --- provider-assisted exact truncation ---------------------------------
 

@@ -3,17 +3,20 @@
 Parity surface: the reference's cross-node sharing flow
 (``x.fix_prec().share(alice, bob, charlie, dan)`` sends one share per Node
 over the WS binary path — SURVEY.md §3.4; host selection in chunks of 4,
-``apps/network/src/app/routes/network.py:16,98-131``).
+``apps/network/src/app/routes/network.py:16,98-131``) and its flagship
+cross-node Beaver matmul with a crypto-provider worker (reference
+``tests/data_centric/test_basic_syft_operations.py:383-491``, refill error
+path ``events/data_centric/syft_events.py:34-45``).
 
 TPU-first split of responsibilities: heavy SMPC *compute* (Beaver
 mul/matmul over batches of parties) runs in the on-chip vmapped plane
 (:mod:`pygrid_tpu.smpc.kernels` / the Pallas matmul); this module covers
 the *protocol* plane — placing one additive share per real node, running
-the share-local linear algebra remotely via pointer ops (additive
-homomorphism: add/sub/public-scale never need communication), and
-reconstructing by opening every share. Shares travel and rest as int64
-(two's complement of the ring element); numpy's wrapping int64 arithmetic
-on the remote parties IS ring-2^64 arithmetic.
+the share-local algebra remotely via pointer ops, opening only masked
+values (Beaver's d/e, the truncation mask m), and reconstructing secrets
+by opening every share. Shares travel and rest as int64 (two's complement
+of the ring element); numpy's wrapping int64 arithmetic on the remote
+parties IS ring-2^64 arithmetic.
 """
 
 from __future__ import annotations
@@ -22,25 +25,145 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from pygrid_tpu.plans.placeholder import fresh_id
+from pygrid_tpu.runtime import messages as M
+from pygrid_tpu.runtime.pointers import PointerTensor, _raise_if_error
 from pygrid_tpu.smpc import ring as R
 from pygrid_tpu.smpc.additive import AdditiveSharingTensor
 from pygrid_tpu.smpc.fixed import FixedPointEncoder
+from pygrid_tpu.smpc.kernels import OFFSET_BITS
+from pygrid_tpu.utils.exceptions import EmptyCryptoPrimitiveStoreError
+
+
+def _raw_cmd(location, op: str, args: list) -> PointerTensor:
+    """Issue one remote op with explicit (possibly public-first) args."""
+    resp = _raise_if_error(
+        location.recv_obj_msg(
+            M.TensorCommandMessage(op=op, args=args, return_id=fresh_id())
+        )
+    )
+    return PointerTensor(
+        location=location, id_at_location=resp.id_at_location, shape=resp.shape
+    )
+
+
+class RemoteCryptoProvider:
+    """Client handle to a crypto-provider worker on the grid.
+
+    ``location`` is anything with ``recv_obj_msg`` — a
+    :class:`~pygrid_tpu.client.data_centric.DataCentricFLClient` dialed at
+    the provider node, or an in-process VirtualWorker with an attached
+    :class:`~pygrid_tpu.smpc.provider.CryptoProvider`. The provider deals
+    per-party share arrays directly to the share-holder nodes over its own
+    node mesh (reference: james in ``x.share(..., crypto_provider=james)``).
+
+    ``auto_refill=True`` reproduces the reference client's transparent
+    refill round-trip: an ``EmptyCryptoPrimitiveStoreError`` coming back
+    over the wire triggers one ``provide`` request built from the error's
+    kwargs, then a retry (reference ``syft_events.py:34-45``).
+    """
+
+    def __init__(self, location: Any, auto_refill: bool = True) -> None:
+        self.location = location
+        self.auto_refill = auto_refill
+
+    @property
+    def id(self) -> str:
+        return getattr(self.location, "id", str(self.location))
+
+    def provide(
+        self,
+        op: str,
+        shape_x: Sequence[int],
+        shape_y: Sequence[int],
+        n_parties: int,
+        n_instances: int = 1,
+    ) -> None:
+        """The refill request (reference's provide-primitives round)."""
+        self.location.recv_obj_msg(
+            M.CryptoProvideMessage(
+                op=op,
+                shape_x=list(shape_x),
+                shape_y=list(shape_y),
+                n_parties=int(n_parties),
+                n_instances=int(n_instances),
+            )
+        )
+
+    def _request(self, msg: M.CryptoRequestMessage) -> M.CryptoDealResponse:
+        try:
+            return _raise_if_error(self.location.recv_obj_msg(msg))
+        except EmptyCryptoPrimitiveStoreError as err:
+            if not self.auto_refill:
+                raise
+            kw = err.kwargs_
+            self.provide(
+                kw.get("op", msg.op),
+                kw.get("shapes", [msg.shape_x, msg.shape_y])[0],
+                kw.get("shapes", [msg.shape_x, msg.shape_y])[1],
+                kw.get("n_parties", len(msg.party_ids)),
+                kw.get("n_instances", 1),
+            )
+            return _raise_if_error(self.location.recv_obj_msg(msg))
+
+    def deal(
+        self,
+        op: str,
+        shape_x: Sequence[int],
+        shape_y: Sequence[int],
+        parties: Sequence[Any],
+    ) -> list[list[PointerTensor]]:
+        """Deal one primitive; returns per-component pointer lists
+        (``[component][party]``) addressed through the caller's own
+        connections to the party nodes."""
+        party_ids = [getattr(p, "id", str(p)) for p in parties]
+        resp = self._request(
+            M.CryptoRequestMessage(
+                op=op,
+                shape_x=list(shape_x),
+                shape_y=list(shape_y),
+                party_ids=party_ids,
+            )
+        )
+        sx, sy = tuple(shape_x), tuple(shape_y)
+        if op == "matmul":
+            shapes = [sx, sy, sx[:-1] + sy[1:]]
+        elif op == "trunc":
+            shapes = [sx, sx]  # [r], [r/scale] both carry the value shape
+        else:
+            shapes = [sx, sy, np.broadcast_shapes(sx, sy)]
+        n_components = len(resp.ids[0])
+        return [
+            [
+                PointerTensor(
+                    location=parties[i],
+                    id_at_location=resp.ids[i][k],
+                    shape=shapes[k],
+                )
+                for i in range(len(parties))
+            ]
+            for k in range(n_components)
+        ]
 
 
 class RemoteSharedTensor:
     """Handle to a secret whose additive shares live on remote nodes.
 
     ``pointers[i]`` points at owner i's int64 share array. Linear ops are
-    share-local (one remote op per node, no cross-node traffic); ``get()``
-    opens the secret by fetching and summing all shares."""
+    share-local (one remote op per node, no cross-node traffic);
+    multiplicative ops run the Beaver round over the grid with a
+    :class:`RemoteCryptoProvider`; ``get()`` opens the secret by fetching
+    and summing all shares."""
 
     def __init__(
         self,
         pointers: list,
         encoder: FixedPointEncoder | None,
+        provider: RemoteCryptoProvider | None = None,
     ) -> None:
         self.pointers = list(pointers)
         self.encoder = encoder
+        self.provider = provider
 
     @property
     def n_parties(self) -> int:
@@ -49,6 +172,10 @@ class RemoteSharedTensor:
     @property
     def locations(self) -> list:
         return [p.location for p in self.pointers]
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self.pointers[0].shape or ())
 
     # --- open ---------------------------------------------------------------
 
@@ -83,7 +210,9 @@ class RemoteSharedTensor:
             getattr(a, op)(b)
             for a, b in zip(self.pointers, other.pointers)
         ]
-        return RemoteSharedTensor(ptrs, self.encoder)
+        return RemoteSharedTensor(
+            ptrs, self.encoder, self.provider or other.provider
+        )
 
     def __add__(self, other: "RemoteSharedTensor") -> "RemoteSharedTensor":
         return self._zip_op(other, "__add__")
@@ -97,11 +226,135 @@ class RemoteSharedTensor:
         if not float(c).is_integer():
             raise ValueError("public factor must be an integer")
         ptrs = [p * np.int64(int(c)) for p in self.pointers]
-        return RemoteSharedTensor(ptrs, self.encoder)
+        return RemoteSharedTensor(ptrs, self.encoder, self.provider)
+
+    # --- multiplicative ops: Beaver over the grid protocol ------------------
+
+    def __mul__(self, other) -> "RemoteSharedTensor":
+        if isinstance(other, RemoteSharedTensor):
+            return self._beaver_remote(other, "mul")
+        return self.mul_public(other)
+
+    def __matmul__(self, other) -> "RemoteSharedTensor":
+        if not isinstance(other, RemoteSharedTensor):
+            raise TypeError("matmul with public operands: share the public side")
+        return self._beaver_remote(other, "matmul")
+
+    def _beaver_remote(
+        self, other: "RemoteSharedTensor", op: str
+    ) -> "RemoteSharedTensor":
+        """One Beaver round across real nodes.
+
+        The provider node deals triple shares [a],[b],[c] directly to each
+        share-holder; the masked differences d = x−a, e = y−b are opened
+        (they are uniform — opening them is the protocol, not a leak); each
+        node combines share-locally; only party 0 folds in the public d∘e.
+        Fixed-point products then rescale via mask-and-open truncation —
+        at no point does any single node (provider included) hold the
+        secret. Mirrors reference test_basic_syft_operations.py:455-491.
+        """
+        if self._party_ids() != other._party_ids():
+            raise ValueError(
+                "operands are shared over different parties: "
+                f"{self._party_ids()} vs {other._party_ids()}"
+            )
+        if (self.encoder is None) != (other.encoder is None) or (
+            self.encoder is not None
+            and self.encoder.scale != other.encoder.scale
+        ):
+            raise ValueError("mismatched fixed-point encoders")
+        provider = self.provider or other.provider
+        if provider is None:
+            raise ValueError("this operation requires a crypto_provider")
+        ring = np.int64  # shares/masks travel as wrapping int64
+        combine = (
+            (lambda u, v: u * v) if op == "mul" else (lambda u, v: u @ v)
+        )
+
+        a_ptrs, b_ptrs, c_ptrs = provider.deal(
+            op, self.shape, other.shape, self.locations
+        )
+        # share-local masking, then open the (uniform) masked differences
+        d = _open_pointers(
+            [x - a for x, a in zip(self.pointers, a_ptrs)]
+        ).astype(ring)
+        e = _open_pointers(
+            [y - b for y, b in zip(other.pointers, b_ptrs)]
+        ).astype(ring)
+        with np.errstate(over="ignore"):
+            de = combine(d, e)
+        z_ptrs = []
+        for i, (a, b, c) in enumerate(zip(a_ptrs, b_ptrs, c_ptrs)):
+            loc = c.location
+            if op == "mul":
+                db = b * d  # share-local: public d ∘ [b]_i
+                ae = a * e
+            else:
+                db = _raw_cmd(loc, "__matmul__", [d, M.ref(b.id_at_location)])
+                ae = a @ e
+            t = c + db
+            z = t + ae
+            if i == 0:
+                zd = z + de
+                z.delete()
+                z = zd
+            for tmp in (a, b, c, db, ae, t):
+                tmp.delete()
+            z_ptrs.append(z)
+        if self.encoder is not None:
+            z_ptrs = self._truncate_remote(z_ptrs, provider)
+        return RemoteSharedTensor(z_ptrs, self.encoder, provider)
+
+    def _truncate_remote(
+        self, z_ptrs: list, provider: RemoteCryptoProvider
+    ) -> list:
+        """Mask-and-open rescale of product shares by the encoder scale —
+        the wire twin of :func:`pygrid_tpu.smpc.kernels.masked_truncate`
+        (same pair, same offset, ε ∈ {0,1} ULP error; no node sees the
+        product, the client sees only the masked open)."""
+        scale = self.encoder.scale
+        shape = tuple(z_ptrs[0].shape or ())
+        locations = [p.location for p in z_ptrs]
+        r_ptrs, rp_ptrs = provider.deal("trunc", shape, [scale], locations)
+        offset = int(scale) << OFFSET_BITS
+        m_ptrs = []
+        for i, (z, r) in enumerate(zip(z_ptrs, r_ptrs)):
+            m = z + r
+            if i == 0:
+                mo = m + np.int64(offset)
+                m.delete()
+                m = mo
+            z.delete()
+            r.delete()
+            m_ptrs.append(m)
+        m = _open_pointers(m_ptrs)  # masked: z + scale·2^30 + r, < 2^63
+        q_minus = (m.astype(np.uint64) // np.uint64(scale)).astype(
+            np.int64
+        ) - np.int64(1 << OFFSET_BITS)
+        out = []
+        for i, rp in enumerate(rp_ptrs):
+            if i == 0:
+                out.append(
+                    _raw_cmd(
+                        rp.location, "__sub__", [q_minus, M.ref(rp.id_at_location)]
+                    )
+                )
+                rp.delete()
+            else:
+                out.append(-rp)
+                rp.delete()
+        return out
 
     def __repr__(self) -> str:
         locs = [getattr(loc, "id", loc) for loc in self.locations]
         return f"RemoteSharedTensor(parties={locs})"
+
+
+def _open_pointers(ptrs: Sequence[PointerTensor]) -> np.ndarray:
+    """Fetch and ring-sum a set of share pointers (consumes the objects)."""
+    return sum_int64_wrapping(
+        [np.asarray(p.get()).astype(np.int64) for p in ptrs]
+    )
 
 
 def sum_int64_wrapping(arrays: Sequence[np.ndarray]) -> np.ndarray:
@@ -119,21 +372,30 @@ def share_to_nodes(
     clients: Sequence[Any],
     encoder: FixedPointEncoder | None = None,
     tags: Sequence[str] = (),
+    crypto_provider: RemoteCryptoProvider | Any | None = None,
 ) -> RemoteSharedTensor:
     """Split ``x`` into len(clients) additive shares, one per node.
 
     ``clients``: DataCentricFLClient-like locations (anything pointers can
-    ``send`` through). Mirrors the reference's
-    ``x.fix_prec().share(*nodes)``."""
+    ``send`` through). ``crypto_provider``: a :class:`RemoteCryptoProvider`
+    (or a bare provider-node location, which is wrapped) enabling Beaver
+    mul/matmul. Mirrors the reference's
+    ``x.fix_prec().share(*nodes, crypto_provider=james)``."""
     owners = [getattr(c, "id", str(i)) for i, c in enumerate(clients)]
     ast = AdditiveSharingTensor.share(
         np.asarray(x), owners, encoder=encoder
     )
+    from pygrid_tpu.runtime.pointers import send as _send
+
     share_arrays = R.from_ring(ast.shares).astype(np.int64)  # [P, ...]
     pointers = []
     for i, client in enumerate(clients):
-        pointers.append(client.send(share_arrays[i], tags=set(tags)))
-    return RemoteSharedTensor(pointers, encoder)
+        pointers.append(_send(share_arrays[i], client, tags=set(tags)))
+    if crypto_provider is not None and not isinstance(
+        crypto_provider, RemoteCryptoProvider
+    ):
+        crypto_provider = RemoteCryptoProvider(crypto_provider)
+    return RemoteSharedTensor(pointers, encoder, crypto_provider)
 
 
 def fix_prec_share_to_nodes(
@@ -142,7 +404,11 @@ def fix_prec_share_to_nodes(
     base: int = 10,
     precision_fractional: int = 3,
     tags: Sequence[str] = (),
+    crypto_provider: RemoteCryptoProvider | Any | None = None,
 ) -> RemoteSharedTensor:
-    """``x.fix_prec().share(alice, bob, …)`` over real nodes."""
+    """``x.fix_prec().share(alice, bob, …, crypto_provider=james)`` over
+    real nodes."""
     encoder = FixedPointEncoder(base, precision_fractional)
-    return share_to_nodes(x, clients, encoder=encoder, tags=tags)
+    return share_to_nodes(
+        x, clients, encoder=encoder, tags=tags, crypto_provider=crypto_provider
+    )

@@ -85,3 +85,74 @@ def test_different_party_sets_rejected(clients):
     sy = share_to_nodes(np.array([2]), [clients[2], clients[3]])
     with pytest.raises(ValueError, match="different parties"):
         _ = sx + sy
+
+
+# --- cross-node Beaver multiplication (reference :455-491) ------------------
+
+
+@pytest.fixture()
+def beaver_grid(grid, clients):
+    """dan deals primitives to alice/bob/charlie over the node mesh — the
+    reference's ``x.share(alice, bob, charlie, crypto_provider=james)``
+    topology (test_basic_syft_operations.py:455-491)."""
+    from pygrid_tpu.smpc import RemoteCryptoProvider
+
+    provider_client, holders = clients[3], clients[:3]
+    for c in holders:
+        provider_client.connect_nodes(c)
+    return RemoteCryptoProvider(provider_client), holders
+
+
+def test_cross_node_beaver_matmul(beaver_grid):
+    rp, holders = beaver_grid
+    rng = np.random.default_rng(3)
+    x = rng.uniform(-2, 2, (3, 4))
+    y = rng.uniform(-2, 2, (4, 2))
+    sx = fix_prec_share_to_nodes(x, holders, crypto_provider=rp)
+    sy = fix_prec_share_to_nodes(y, holders, crypto_provider=rp)
+    np.testing.assert_allclose((sx @ sy).get(), x @ y, atol=2e-2)
+
+
+def test_cross_node_beaver_mul(beaver_grid):
+    rp, holders = beaver_grid
+    x = np.array([[1.5, -2.0], [0.25, 3.0]])
+    y = np.array([[2.0, 0.5], [-1.0, 1.5]])
+    sx = fix_prec_share_to_nodes(x, holders, crypto_provider=rp)
+    sy = fix_prec_share_to_nodes(y, holders, crypto_provider=rp)
+    np.testing.assert_allclose((sx * sy).get(), x * y, atol=5e-3)
+
+
+def test_cross_node_int_matmul_exact(beaver_grid):
+    rp, holders = beaver_grid
+    ix = np.array([[3, -7], [2, 5]], dtype=np.int64)
+    iy = np.array([[2, 1], [-4, 6]], dtype=np.int64)
+    six = share_to_nodes(ix, holders, crypto_provider=rp)
+    siy = share_to_nodes(iy, holders, crypto_provider=rp)
+    np.testing.assert_array_equal((six @ siy).get(), ix @ iy)
+
+
+def test_strict_store_refill_over_wire(grid, beaver_grid):
+    """The EmptyCryptoPrimitiveStoreError must cross the WS wire typed and
+    carrying its refill kwargs (reference syft_events.py:34-45), and the
+    client's provide round-trip must unblock the op."""
+    from pygrid_tpu.smpc import RemoteCryptoProvider
+    from pygrid_tpu.utils.exceptions import EmptyCryptoPrimitiveStoreError
+
+    rp, holders = beaver_grid
+    dealer = grid.nodes["dan"].app["node"].crypto_provider
+    dealer.strict_store = True
+    try:
+        x = np.array([[1.0, 2.0]])
+        y = np.array([[3.0], [4.0]])
+        strict_rp = RemoteCryptoProvider(rp.location, auto_refill=False)
+        sx = fix_prec_share_to_nodes(x, holders, crypto_provider=strict_rp)
+        sy = fix_prec_share_to_nodes(y, holders, crypto_provider=strict_rp)
+        with pytest.raises(EmptyCryptoPrimitiveStoreError) as exc:
+            _ = sx @ sy
+        assert exc.value.kwargs_["op"] == "matmul"
+        assert exc.value.kwargs_["n_parties"] == 3
+        # auto-refill mode drives provide() from the error kwargs and retries
+        sx.provider = RemoteCryptoProvider(rp.location, auto_refill=True)
+        np.testing.assert_allclose((sx @ sy).get(), x @ y, atol=2e-2)
+    finally:
+        dealer.strict_store = False
