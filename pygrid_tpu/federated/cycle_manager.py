@@ -135,6 +135,10 @@ class CycleManager:
                 break
         if wc is None:
             raise E.InvalidRequestKeyError()
+        if not diff:
+            # an empty blob must not count toward readiness — completed rows
+            # are what complete_cycle counts, so every one must carry a diff
+            raise E.PyGridError("empty diff")
         self._worker_cycles.modify(
             {"id": wc.id},
             {
@@ -162,7 +166,10 @@ class CycleManager:
         server_config = self.process_manager.get_configs(
             fl_process_id=process.id, is_server_config=True
         )
-        received = len(self._received_diffs(cycle_id))
+        # readiness needs only the COUNT — loading the diff blobs here would
+        # read O(K) megabytes per report, O(K²) per cycle; the blobs are
+        # fetched once, in _average_plan_diffs, when the cycle is ready
+        received = self._worker_cycles.count(cycle_id=cycle_id, is_completed=True)
         min_diffs = server_config.get("min_diffs")
         max_diffs = server_config.get("max_diffs")
         has_limits = max_diffs is not None or cycle.end is not None
@@ -205,9 +212,15 @@ class CycleManager:
                 )
             else:
                 # hardcoded FedAvg fallback (reference reduce(th.add)/th.div
-                # :275-290) — stacked mean in one XLA launch
+                # :275-290) — stacked mean in one XLA launch. Stack on host
+                # first so each parameter is ONE host→device transfer of a
+                # [K, ...] buffer, not K small transfers; at K=256+ diffs
+                # per cycle the transfer count, not the reduction, is the
+                # scaling wall.
                 stacked = [
-                    jnp.stack([np.asarray(d[i]) for d in diff_params])
+                    jnp.asarray(
+                        np.stack([np.asarray(d[i]) for d in diff_params])
+                    )
                     for i in range(len(params))
                 ]
                 avg_diff = _mean_stacked(stacked)

@@ -6,6 +6,7 @@ from pygrid_tpu.parallel.mesh import (  # noqa: F401
 )
 from pygrid_tpu.parallel.fedavg import (  # noqa: F401
     make_round,
+    make_scanned_rounds,
     make_sharded_round,
     run_rounds,
 )

@@ -131,6 +131,51 @@ def make_sharded_round(
     return jax.jit(sharded)
 
 
+def make_scanned_rounds(
+    training_step: Callable,
+    n_rounds: int,
+    local_steps: int = 1,
+    matmul_precision: str | None = None,
+) -> Callable:
+    """All ``n_rounds`` FedAvg rounds fused into ONE XLA program.
+
+    ``lax.scan`` over rounds keeps the whole multi-round simulation on
+    device — no host round-trip per round (the loop being replaced lived in
+    :func:`run_rounds`; the reference's analog re-enters Python every cycle,
+    reference cycle_manager.py:309-323). Returns
+    ``rounds_fn(params, client_X, client_y, lr) -> (final_params,
+    losses[n_rounds], accs[n_rounds])``.
+    """
+
+    @jax.jit
+    def rounds_fn(params, client_X, client_y, lr):
+        def one_client(p, X, y):
+            new_p, loss, acc = _client_update(
+                training_step, p, X, y, lr, local_steps
+            )
+            return [a - b for a, b in zip(p, new_p)], loss, acc
+
+        def one_round(p, _):
+            diffs, losses, accs = jax.vmap(
+                lambda X, y: one_client(p, X, y)
+            )(client_X, client_y)
+            avg_diff = [jnp.mean(d, axis=0) for d in diffs]
+            new_params = [a - d for a, d in zip(p, avg_diff)]
+            return new_params, (jnp.mean(losses), jnp.mean(accs))
+
+        def body():
+            return lax.scan(one_round, list(params), None, length=n_rounds)
+
+        if matmul_precision is None:
+            final, (losses, accs) = body()
+        else:
+            with jax.default_matmul_precision(matmul_precision):
+                final, (losses, accs) = body()
+        return final, losses, accs
+
+    return rounds_fn
+
+
 def run_rounds(
     round_fn: Callable,
     params: Sequence,
@@ -139,7 +184,10 @@ def run_rounds(
     lr,
     n_rounds: int,
 ):
-    """Drive n FedAvg rounds host-side (each round one XLA launch)."""
+    """Drive n FedAvg rounds host-side (each round one XLA launch).
+
+    For a fully on-device multi-round simulation use
+    :func:`make_scanned_rounds` — one launch for all rounds."""
     metrics = []
     for _ in range(n_rounds):
         params, loss, acc = round_fn(params, client_X, client_y, lr)

@@ -349,3 +349,49 @@ def test_auth_expired_token():
     token = fed_auth.jwt_encode({"sub": "w", "exp": time.time() - 10}, secret="s")
     with pytest.raises(AuthorizationError):
         fed_auth.verify_token(token, cfg)
+
+
+def test_aggregation_scales_to_256_diffs():
+    """One cycle ingesting 256 worker diffs: the stacked-mean path must
+    stage all diffs as one [K, ...] device buffer per parameter and produce
+    the exact average (the scaling case the reference's per-diff reduce
+    loop, cycle_manager.py:275-290, cannot batch)."""
+    K = 256
+    db = Database(":memory:")
+    ctl = FLController(db)
+    params = _model_params()
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": _training_plan()},
+        name="mnist-wide",
+        version="1.0",
+        client_config=dict(CLIENT_CONFIG, name="mnist-wide"),
+        server_config=dict(
+            SERVER_CONFIG,
+            min_diffs=K,
+            max_diffs=K,
+            min_workers=K,
+            max_workers=K,
+            num_cycles=1,
+        ),
+    )
+    model_id = None
+    for k in range(K):
+        w = _register_worker(ctl, f"wide-{k}")
+        resp = ctl.assign("mnist-wide", "1.0", w)
+        assert resp[CYCLE.STATUS] == CYCLE.ACCEPTED
+        model_id = resp["model_id"]
+        diff = [
+            np.full((10, 4), 0.01 * k, np.float32),
+            np.full((4,), 0.01 * k, np.float32),
+        ]
+        ctl.submit_diff(f"wide-{k}", resp[CYCLE.KEY], serialize_model_params(diff))
+    latest = ctl.model_manager.load(model_id=model_id, alias="latest")
+    new = unserialize_model_params(latest.value)
+    mean_diff = np.float32(np.mean([0.01 * k for k in range(K)], dtype=np.float64))
+    np.testing.assert_allclose(
+        np.asarray(new[0]), params[0] - mean_diff, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(new[1]), params[1] - mean_diff, rtol=1e-4
+    )
