@@ -1,0 +1,79 @@
+"""Regenerate the checked-in exemplar Terraform stacks.
+
+The reference ships hand-written HCL per stack
+(``/root/reference/deploy/serverless-node/*.tf``,
+``deploy/serverless-network/*.tf``, ``deploy/serverfull-node/main.tf``);
+here each stack is RENDERED by the same provider builders the deploy API
+uses (``pygrid_tpu.infra.providers.gcp``), so the checked-in configs can
+never drift from what ``pygrid-tpu deploy`` writes. A unit test
+(tests/unit/test_infra.py) asserts the rendered output matches these files.
+
+Run from the repo root:  python deploy/regenerate.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from pygrid_tpu.infra.config import DeployConfig  # noqa: E402
+from pygrid_tpu.infra.providers import build_provider  # noqa: E402
+
+HERE = pathlib.Path(__file__).resolve().parent
+
+#: the exemplar stacks — the reference's three deploy/ directories plus the
+#: serverless-network twin it also ships
+STACKS: dict[str, dict] = {
+    "gcp-serverfull-node": {
+        "provider": "gcp",
+        "deployment_type": "serverfull",
+        "app": {"name": "node", "id": "alice", "port": 5000,
+                "network": "http://network.example.com:7000"},
+    },
+    "gcp-serverfull-network": {
+        "provider": "gcp",
+        "deployment_type": "serverfull",
+        "app": {"name": "network", "port": 7000},
+    },
+    "gcp-serverless-node": {
+        "provider": "gcp",
+        "deployment_type": "serverless",
+        "app": {"name": "node", "id": "alice", "port": 5000},
+    },
+    "gcp-serverless-network": {
+        "provider": "gcp",
+        "deployment_type": "serverless",
+        "app": {"name": "network", "port": 7000},
+    },
+}
+
+
+def render_stack(name: str) -> dict[str, str]:
+    spec = dict(STACKS[name])
+    config = DeployConfig.from_dict(
+        {
+            **spec,
+            "tpu": {
+                "accelerator_type": "v5litepod-8",
+                "zone": "us-central1-a",
+                "project": "pygrid-tpu-demo",
+            },
+            "db": {"url": "grid.db"},
+        }
+    )
+    return build_provider(config).render()
+
+
+def main() -> None:
+    for name in STACKS:
+        out = HERE / name
+        out.mkdir(parents=True, exist_ok=True)
+        for fname, contents in render_stack(name).items():
+            (out / fname).write_text(contents)
+            print(f"wrote deploy/{name}/{fname}")
+
+
+if __name__ == "__main__":
+    main()

@@ -42,8 +42,15 @@ class GridWSClient:
 
     def connect(self) -> "GridWSClient":
         if self._ws is None:
+            # permessage-deflate off: grid payloads are serde/base64 bytes
+            # (high entropy), where zlib costs ~40x the loopback wire time
+            # per MB and saves nothing — measured 128 ms vs 3.4 ms for a
+            # 1.66MB report frame
             self._ws = connect(
-                self.ws_url, open_timeout=self.timeout, max_size=2**28
+                self.ws_url,
+                open_timeout=self.timeout,
+                max_size=2**28,
+                compression=None,
             )
         return self
 

@@ -123,6 +123,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="run the provider in-process (no deploy API)")
     d.add_argument("--apply", action="store_true",
                    help="actually apply (terraform/spawn); default dry run")
+    d.add_argument("--dry-run", action="store_true",
+                   help="render + terraform-validate in-process and exit "
+                        "(implies --direct --yes, never applies)")
     d.add_argument("--yes", "-y", action="store_true",
                    help="non-interactive: accept defaults/flags")
     d.add_argument("--output-file", default=None)
@@ -131,6 +134,8 @@ def make_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
+    if args.dry_run:
+        args.direct, args.yes, args.apply = True, True, False
     interactive = not args.yes and sys.stdin.isatty()
     config = build_config(args, interactive)
 

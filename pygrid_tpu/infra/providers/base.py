@@ -41,12 +41,19 @@ class Provider:
             with open(os.path.join(self.root_dir, fname), "w") as f:
                 f.write(contents)
         applied = False
-        if apply and self.tf.available() and "main.tf.json" in files:
+        validated: bool | None = None  # None = terraform binary absent
+        if self.tf.available() and "main.tf.json" in files:
             self.tf.init(self.root_dir)
-            applied = self.tf.apply(self.root_dir) == 0
+            # a dry run still validates: the rendered configs must be
+            # terraform-acceptable, not just well-formed JSON (reference
+            # apply path: api/tf.py:11-24)
+            validated = self.tf.validate(self.root_dir) == 0
+            if apply and validated:
+                applied = self.tf.apply(self.root_dir) == 0
         return {
             "root_dir": self.root_dir,
             "files": sorted(files),
+            "validated": validated,
             "applied": applied,
         }
 

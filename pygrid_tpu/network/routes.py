@@ -260,36 +260,14 @@ async def nodes_status(request: web.Request) -> web.Response:
     )
 
 
-def _rbac_twin(event_type: str):
-    """HTTP twin of a user/role/group WS event (same pattern as the Node's
-    ``_ws_twin`` — reference serves both surfaces per app)."""
-    from pygrid_tpu.users.events import USER_HANDLERS
-
-    async def handler(request: web.Request) -> web.Response:
-        ctx = _ctx(request)
-        try:
-            body = (
-                json.loads(await request.text())
-                if request.can_read_body
-                else {}
-            )
-        except json.JSONDecodeError as err:
-            return web.json_response({"error": str(err)}, status=400)
-        token = request.headers.get("token")
-        if token and "token" not in body:
-            body["token"] = token
-        body.update(
-            {k: v for k, v in request.match_info.items() if k not in body}
-        )
-        response = USER_HANDLERS[event_type](ctx, {"data": body})
-        status = 200 if "error" not in response else 400
-        return web.json_response(response, status=status)
-
-    return handler
-
-
 def register(app: web.Application) -> None:
+    from pygrid_tpu.users.events import http_twin
     from pygrid_tpu.utils.codes import ROLE_EVENTS, USER_EVENTS
+
+    def _rbac_twin(event_type):
+        # the shared twin: path params win over body keys, malformed
+        # input maps to 400 (see users/events.py http_twin)
+        return http_twin(event_type, "network")
 
     r = app.router
     r.add_post("/join", join)

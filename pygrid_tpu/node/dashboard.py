@@ -5,9 +5,15 @@ static/js/main.js}`` — a landing page that fetches
 ``/data-centric/detailed-models-list/`` and renders the hosted models.
 Here it is one self-contained page (no static asset tree) that also shows
 node identity/status, so a browser hitting the node root sees the grid
-state."""
+state. All dynamic values — the node id and every model field — render
+through HTML escaping / ``textContent``, never markup interpolation: a
+hosted model id is client-supplied data and must not execute in the
+operator's browser.
+"""
 
 from __future__ import annotations
+
+import html
 
 PAGE = """<!doctype html>
 <html lang="en">
@@ -31,17 +37,34 @@ PAGE = """<!doctype html>
 <tr><th>id</th><th>download</th><th>remote inference</th><th>mpc</th></tr>
 </thead><tbody></tbody></table>
 <script>
+function row(fields) {{
+  const tr = document.createElement('tr');
+  for (const value of fields) {{
+    const td = document.createElement('td');
+    td.textContent = String(value);  // data, never markup
+    tr.appendChild(td);
+  }}
+  return tr;
+}}
 async function refresh() {{
   try {{
     const st = await (await fetch('/data-centric/status/')).json();
     document.getElementById('status').textContent =
       'status: ' + (st.status || JSON.stringify(st));
     const res = await (await fetch('/data-centric/detailed-models-list/')).json();
-    const rows = (res.models || []).map(m =>
-      `<tr><td>${{m.id}}</td><td>${{m.allow_download}}</td>` +
-      `<td>${{m.allow_remote_inference}}</td><td>${{m.mpc}}</td></tr>`);
-    document.querySelector('#models tbody').innerHTML =
-      rows.join('') || '<tr><td colspan=4 class=muted>none</td></tr>';
+    const tbody = document.querySelector('#models tbody');
+    tbody.replaceChildren();
+    const models = res.models || [];
+    if (!models.length) {{
+      const tr = document.createElement('tr');
+      const td = document.createElement('td');
+      td.colSpan = 4; td.className = 'muted'; td.textContent = 'none';
+      tr.appendChild(td); tbody.appendChild(tr);
+    }}
+    for (const m of models) {{
+      tbody.appendChild(
+        row([m.id, m.allow_download, m.allow_remote_inference, m.mpc]));
+    }}
   }} catch (err) {{
     document.getElementById('status').textContent = 'error: ' + err;
   }}
@@ -54,4 +77,4 @@ refresh(); setInterval(refresh, 5000);
 
 
 def render(node_id: str) -> str:
-    return PAGE.format(node_id=node_id)
+    return PAGE.format(node_id=html.escape(str(node_id), quote=True))

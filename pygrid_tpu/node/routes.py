@@ -82,11 +82,25 @@ async def mc_speed_test(request: web.Request) -> web.Response:
             size = int(request.query.get("size", SPEED_TEST_SAMPLE_BYTES))
         except ValueError as err:
             return _json_error(err, 400)
-        # unauthenticated endpoint: cap at the reference's 64MB sample
+        # unauthenticated endpoint: cap at the reference's 64MB sample, and
+        # stream it in chunks — materializing the full sample per request
+        # would let anonymous callers burn 64MB of RSS each
         size = max(0, min(size, SPEED_TEST_SAMPLE_BYTES))
-        return web.Response(
-            body=b"x" * size, content_type="application/octet-stream"
+        response = web.StreamResponse(
+            headers={
+                "Content-Type": "application/octet-stream",
+                "Content-Length": str(size),
+            }
         )
+        await response.prepare(request)
+        chunk = b"x" * min(size, 1 << 20)
+        sent = 0
+        while sent < size:
+            n = min(size - sent, len(chunk))
+            await response.write(chunk[:n])
+            sent += n
+        await response.write_eof()
+        return response
     if request.method == "POST":
         await request.read()  # upload sink
     return web.json_response({})
@@ -114,11 +128,22 @@ async def mc_authenticate(request: web.Request) -> web.Response:
     return web.json_response(response[MSG_FIELD.DATA])
 
 
+def _require_query(request: web.Request, *names: str) -> list[str]:
+    """Explicit 400 bodies for absent params (the reference's download
+    routes answer with named missing-key messages, routes.py:163-250, not
+    a generic 401)."""
+    missing = [n for n in names if not request.query.get(n)]
+    if missing:
+        raise E.MissingRequestKeyError(
+            f"missing query parameter(s): {', '.join(missing)}"
+        )
+    return [request.query[n] for n in names]
+
+
 def _validated_cycle(ctx: NodeContext, request: web.Request, fl_process_id: int):
     """request_key gate shared by the three download routes
     (reference routes.py:163-250)."""
-    worker_id = request.query.get("worker_id")
-    request_key = request.query.get("request_key")
+    worker_id, request_key = _require_query(request, "worker_id", "request_key")
     cycle = ctx.fl.cycle_manager.last(fl_process_id)
     worker = ctx.fl.worker_manager.get(id=worker_id)
     ctx.fl.cycle_manager.validate(worker.id, cycle.id, request_key)
@@ -127,7 +152,7 @@ def _validated_cycle(ctx: NodeContext, request: web.Request, fl_process_id: int)
 async def mc_get_model(request: web.Request) -> web.Response:
     ctx = _ctx(request)
     try:
-        model_id = int(request.query.get("model_id"))
+        model_id = int(_require_query(request, "model_id")[0])
         model = ctx.fl.model_manager.get(id=model_id)
         _validated_cycle(ctx, request, model.fl_process_id)
         checkpoint = ctx.fl.model_manager.load(model_id=model_id)
@@ -141,7 +166,7 @@ async def mc_get_model(request: web.Request) -> web.Response:
 async def mc_get_plan(request: web.Request) -> web.Response:
     ctx = _ctx(request)
     try:
-        plan_id = int(request.query.get("plan_id"))
+        plan_id = int(_require_query(request, "plan_id")[0])
         variant = request.query.get("receive_operations_as", "list")
         plan = ctx.fl.plan_manager.get(id=plan_id, is_avg_plan=False)
         _validated_cycle(ctx, request, plan.fl_process_id)
@@ -156,7 +181,7 @@ async def mc_get_plan(request: web.Request) -> web.Response:
 async def mc_get_protocol(request: web.Request) -> web.Response:
     ctx = _ctx(request)
     try:
-        protocol_id = int(request.query.get("protocol_id"))
+        protocol_id = int(_require_query(request, "protocol_id")[0])
         protocol = ctx.fl.protocol_manager.get(id=protocol_id)
         _validated_cycle(ctx, request, protocol.fl_process_id)
         return web.Response(

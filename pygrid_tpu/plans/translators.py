@@ -245,31 +245,232 @@ _INTERP_TABLE: dict[str, Any] = {
 }
 
 
-def run_oplist(oplist: dict, *args: Any) -> Any:
-    """Interpret the portable op-list dialect. Returns the plan outputs."""
+# --- pure-numpy interpreter table -------------------------------------------
+#
+# The proof that the dialect is portable OFF the XLA stack: a foreign client
+# with only a ndarray library (numpy here; the same table transcribes to JS
+# typed arrays) can run a grad-traced training plan. Covers the full op
+# vocabulary jax.grad produces for the MLP/CNN-style plans the grid hosts
+# (conformance-tested against the XLA variant in tests/unit/test_plans.py).
+
+
+def _np_dot_general(a, b, params):
+    dnums = _tt(params["dimension_numbers"])
+    (lc, rc), (lb, rb) = (
+        tuple(tuple(d) for d in dnums[0]),
+        tuple(tuple(d) for d in dnums[1]),
+    )
+    letters = iter("abcdefghijklmnopqrstuvwxyz")
+    l_sub = [None] * a.ndim
+    r_sub = [None] * b.ndim
+    batch = []
+    for i, j in zip(lb, rb):
+        ch = next(letters)
+        l_sub[i] = r_sub[j] = ch
+        batch.append(ch)
+    for i, j in zip(lc, rc):
+        ch = next(letters)
+        l_sub[i] = r_sub[j] = ch
+    l_free = []
+    for i in range(a.ndim):
+        if l_sub[i] is None:
+            l_sub[i] = next(letters)
+            l_free.append(l_sub[i])
+    r_free = []
+    for j in range(b.ndim):
+        if r_sub[j] is None:
+            r_sub[j] = next(letters)
+            r_free.append(r_sub[j])
+    spec = (
+        f"{''.join(l_sub)},{''.join(r_sub)}->"
+        f"{''.join(batch + l_free + r_free)}"
+    )
+    return np.einsum(spec, a, b)
+
+
+def _np_broadcast_in_dim(a, p):
+    shape, bcd = _dims(p["shape"]), _dims(p["broadcast_dimensions"])
+    staged = [1] * len(shape)
+    for i, d in enumerate(bcd):
+        staged[d] = a.shape[i]
+    return np.broadcast_to(np.reshape(a, staged), shape)
+
+
+def _np_iota(p):
+    shape, dim = _dims(p["shape"]), int(p["dimension"])
+    ar = np.arange(shape[dim], dtype=_dt(p["dtype"]))
+    view = np.reshape(
+        ar, [shape[dim] if i == dim else 1 for i in range(len(shape))]
+    )
+    return np.broadcast_to(view, shape)
+
+
+def _np_reduce(fn):
+    def run(x, params):
+        return fn(x, axis=_dims(params["axes"]) or None)
+
+    return run
+
+
+def _np_select_n(*args):
+    which, cases = args[0], list(args[1:-1])
+    if len(cases) == 2 and which.dtype == np.bool_:
+        return np.where(which, cases[1], cases[0])
+    return np.select([which == i for i in range(len(cases))], cases)
+
+
+def _np_slice(a, p):
+    idx = tuple(
+        slice(s, l, (st if st else None))
+        for s, l, st in zip(
+            _dims(p["start_indices"]),
+            _dims(p["limit_indices"]),
+            _dims(p["strides"]) if p.get("strides") else [None] * a.ndim,
+        )
+    )
+    return a[idx]
+
+
+def _np_dynamic_slice(*args):
+    a, starts, p = args[0], args[1:-1], args[-1]
+    sizes = _dims(p["slice_sizes"])
+    clamped = [
+        int(np.clip(int(s), 0, d - n))
+        for s, d, n in zip(starts, a.shape, sizes)
+    ]
+    return a[tuple(slice(c, c + n) for c, n in zip(clamped, sizes))]
+
+
+_NUMPY_TABLE: dict[str, Any] = {
+    "add": lambda a, b, p: np.add(a, b),
+    "add_any": lambda a, b, p: np.add(a, b),
+    "sub": lambda a, b, p: np.subtract(a, b),
+    "mul": lambda a, b, p: np.multiply(a, b),
+    "div": lambda a, b, p: np.divide(a, b),
+    "pow": lambda a, b, p: np.power(a, b),
+    "rem": lambda a, b, p: np.fmod(a, b),  # lax.rem: C-style truncation
+    "atan2": lambda a, b, p: np.arctan2(a, b),
+    "nextafter": lambda a, b, p: np.nextafter(a, b),
+    "max": lambda a, b, p: np.maximum(a, b),
+    "min": lambda a, b, p: np.minimum(a, b),
+    "and": lambda a, b, p: np.logical_and(a, b),
+    "or": lambda a, b, p: np.logical_or(a, b),
+    "xor": lambda a, b, p: np.logical_xor(a, b),
+    "gt": lambda a, b, p: np.greater(a, b),
+    "lt": lambda a, b, p: np.less(a, b),
+    "ge": lambda a, b, p: np.greater_equal(a, b),
+    "le": lambda a, b, p: np.less_equal(a, b),
+    "eq": lambda a, b, p: np.equal(a, b),
+    "ne": lambda a, b, p: np.not_equal(a, b),
+    "clamp": lambda lo, x, hi, p: np.clip(x, lo, hi),
+    "cumsum": lambda a, p: (
+        np.flip(np.cumsum(np.flip(a, int(np.asarray(p["axis"]))),
+                          int(np.asarray(p["axis"]))),
+                int(np.asarray(p["axis"])))
+        if bool(p.get("reverse", False))
+        else np.cumsum(a, int(np.asarray(p["axis"])))
+    ),
+    "neg": lambda a, p: np.negative(a),
+    "sign": lambda a, p: np.sign(a),
+    "abs": lambda a, p: np.abs(a),
+    "exp": lambda a, p: np.exp(a),
+    "exp2": lambda a, p: np.exp2(a),
+    "log": lambda a, p: np.log(a),
+    "tanh": lambda a, p: np.tanh(a),
+    "sqrt": lambda a, p: np.sqrt(a),
+    "rsqrt": lambda a, p: 1.0 / np.sqrt(a),
+    "logistic": lambda a, p: 1.0 / (1.0 + np.exp(-a)),
+    "floor": lambda a, p: np.floor(a),
+    "ceil": lambda a, p: np.ceil(a),
+    "round": lambda a, p: np.round(a),  # both default to half-to-even
+    "is_finite": lambda a, p: np.isfinite(a),
+    "stop_gradient": lambda a, p: a,
+    "copy": lambda a, p: a,
+    "integer_pow": lambda a, p: a ** int(p["y"]),
+    "square": lambda a, p: np.square(a),
+    "convert_element_type": lambda a, p: np.asarray(a).astype(
+        _dt(p["new_dtype"])
+    ),
+    "reshape": lambda a, p: np.reshape(a, _dims(p["new_sizes"])),
+    "squeeze": lambda a, p: np.squeeze(a, axis=_dims(p["dimensions"]) or None),
+    "expand_dims": lambda a, p: np.expand_dims(a, _dims(p["dimensions"])),
+    "transpose": lambda a, p: np.transpose(a, _dims(p["permutation"])),
+    "broadcast_in_dim": _np_broadcast_in_dim,
+    "slice": _np_slice,
+    "rev": lambda a, p: np.flip(a, _dims(p["dimensions"])),
+    "reduce_sum": _np_reduce(np.sum),
+    "reduce_max": _np_reduce(np.max),
+    "reduce_min": _np_reduce(np.min),
+    "reduce_prod": _np_reduce(np.prod),
+    "reduce_and": _np_reduce(np.all),
+    "reduce_or": _np_reduce(np.any),
+    "argmax": lambda a, p: np.argmax(a, axis=_dims(p["axes"])[0]).astype(
+        _dt(p["index_dtype"])
+    ),
+    "argmin": lambda a, p: np.argmin(a, axis=_dims(p["axes"])[0]).astype(
+        _dt(p["index_dtype"])
+    ),
+    "select_n": _np_select_n,
+    "dot_general": _np_dot_general,
+    "concatenate": lambda *args: np.concatenate(
+        list(args[:-1]), int(args[-1]["dimension"])
+    ),
+    "iota": _np_iota,
+    "dynamic_slice": _np_dynamic_slice,
+    "dynamic_update_slice": lambda a, u, *rest: _np_dus(a, u, rest[:-1]),
+}
+
+
+def _np_dus(a, u, starts):
+    out = np.array(a, copy=True)
+    clamped = [
+        int(np.clip(int(s), 0, d - n))
+        for s, d, n in zip(starts, a.shape, u.shape)
+    ]
+    out[tuple(slice(c, c + n) for c, n in zip(clamped, u.shape))] = u
+    return out
+
+
+#: sub-jaxpr wrapper primitives: executed by running the inner jaxpr
+_CALL_OPS = (
+    "jit", "pjit", "closed_call", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "remat", "checkpoint", "custom_transpose_call",
+)
+
+
+def run_oplist(oplist: dict, *args: Any, backend: str = "jax") -> Any:
+    """Interpret the portable op-list dialect. Returns the plan outputs.
+
+    ``backend="jax"`` executes on the accelerator via jnp/lax (the
+    reference interpreter); ``backend="numpy"`` executes with numpy only —
+    the path proving a non-XLA client (the tfjs-analog consumer,
+    reference plan_manager.py:119-149) can run a hosted training plan.
+    """
+    if backend == "numpy":
+        table, lift = _NUMPY_TABLE, np.asarray
+    else:
+        table, lift = _INTERP_TABLE, jnp.asarray
     env: dict[int, Any] = {}
     for cid, cval in zip(oplist["constvars"], oplist["consts"]):
-        env[cid] = jnp.asarray(cval)
+        env[cid] = lift(cval)
     if len(args) != len(oplist["invars"]):
         raise PlanTranslationError(
             f"oplist expects {len(oplist['invars'])} args, got {len(args)}"
         )
     for iid, a in zip(oplist["invars"], args):
-        env[iid] = jnp.asarray(a)
+        env[iid] = lift(a)
 
     def read(r):
         if "var" in r:
             return env[r["var"]]
         if "lit" in r:
             return r["lit"]
-        return jnp.asarray(r["lit_arr"])
+        return lift(r["lit_arr"])
 
     for eqn in oplist["eqns"]:
         op, params = eqn["op"], eqn["params"]
         invals = [read(r) for r in eqn["in"]]
-        if op in ("jit", "pjit", "closed_call", "custom_jvp_call",
-                  "custom_vjp_call", "custom_jvp_call_jaxpr", "remat",
-                  "checkpoint", "custom_transpose_call"):
+        if op in _CALL_OPS:
             inner = None
             for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
                 cand = params.get(key)
@@ -278,12 +479,14 @@ def run_oplist(oplist: dict, *args: Any) -> Any:
                     break
             if inner is None:
                 raise PlanTranslationError(f"no inner jaxpr for {op}")
-            outs = run_oplist(inner, *invals)
+            outs = run_oplist(inner, *invals, backend=backend)
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
         else:
-            fn = _INTERP_TABLE.get(op)
+            fn = table.get(op)
             if fn is None:
-                raise PlanTranslationError(f"op {op!r} not in portable dialect")
+                raise PlanTranslationError(
+                    f"op {op!r} not in portable dialect ({backend} backend)"
+                )
             outs = [fn(params)] if op == "iota" else [fn(*invals, params)]
         for oid, oval in zip(eqn["out"], outs):
             env[oid] = oval

@@ -1,0 +1,103 @@
+"""Multi-process DCN smoke test — the multi-host path actually executed.
+
+SURVEY §4's stated analog for the reference's multi-node socket grid:
+"multi-host tested via jax multiprocess on a single host". Two real
+processes form a ``jax.distributed`` cluster over localhost (the DCN in
+miniature), build the topology-aware branch of
+:func:`pygrid_tpu.parallel.distributed.hybrid_mesh` (2 hosts × 4 virtual
+CPU chips), and run one :func:`make_sharded_round` FedAvg round whose
+client axis is sharded across the processes — the collective mean crosses
+the process boundary.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+coord, pid = sys.argv[1], int(sys.argv[2])
+jax.distributed.initialize(
+    coordinator_address=coord, num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+import numpy as np
+sys.path.insert(0, {repo!r})
+from pygrid_tpu.models import mlp
+from pygrid_tpu.parallel import make_round, make_sharded_round
+from pygrid_tpu.parallel.distributed import (
+    data_sharding, hybrid_mesh, host_array, local_batch_slice,
+)
+from jax.sharding import PartitionSpec as P
+
+# the topology-aware branch: 2 processes on the DCN axis x 4 chips on ICI
+mesh = hybrid_mesh(dcn_axis="clients", ici_axes=("model",), ici_shape=(4,))
+assert mesh.shape == {{"clients": 2, "model": 4}}, dict(mesh.shape)
+
+K, B, D, H, C = 8, 4, 16, 8, 10
+params = [np.asarray(p) for p in mlp.init(jax.random.PRNGKey(0), (D, H, C))]
+rng = np.random.default_rng(0)
+X_global = rng.normal(size=(K, B, D)).astype(np.float32)
+y_global = np.eye(C, dtype=np.float32)[rng.integers(0, C, (K, B))]
+
+# every process feeds ONLY its local shard of the client axis
+rows = local_batch_slice(K, mesh, dcn_axis="clients")
+X = host_array(X_global[rows], mesh, P("clients"))
+y = host_array(y_global[rows], mesh, P("clients"))
+
+round_fn = make_sharded_round(mlp.training_step, mesh, axis="clients")
+import jax.numpy as jnp
+new_params, loss, acc = round_fn(params, X, y, jnp.float32(0.1))
+loss = float(loss)
+
+# ground truth: the same round on one local device
+ref_params, ref_loss, _ = make_round(mlp.training_step)(
+    params, X_global, y_global, jnp.float32(0.1)
+)
+np.testing.assert_allclose(loss, float(ref_loss), rtol=1e-5)
+for a, b in zip(new_params, ref_params):
+    np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+    )
+print(f"DCN-OK process={{pid}} loss={{loss:.5f}}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dcn_fedavg_round(tmp_path):
+    script = tmp_path / "dcn_worker.py"
+    script.write_text(WORKER.format(repo=str(REPO)))
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord, str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=str(REPO),
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+        assert f"DCN-OK process={pid}" in out

@@ -198,3 +198,64 @@ def test_req_join_admission(grid, hosted):
         "up_speed": "-1", "down_speed": "0",
     }, timeout=10)
     assert slow.status_code == 400 and slow.json()["status"] == "rejected"
+
+
+def test_download_routes_name_missing_params(grid, hosted):
+    """Absent worker_id/request_key/model_id answer 400 with the missing
+    names spelled out (reference routes.py:163-250 error bodies), not a
+    generic 401."""
+    import requests
+
+    base = grid.node_url("alice") + "/model-centric"
+    r = requests.get(base + "/get-model", params={"model_id": "1"}, timeout=10)
+    assert r.status_code == 400
+    assert "worker_id" in r.json()["error"] and "request_key" in r.json()["error"]
+    r = requests.get(base + "/get-model", timeout=10)
+    assert r.status_code == 400 and "model_id" in r.json()["error"]
+    r = requests.get(base + "/get-plan", timeout=10)
+    assert r.status_code == 400 and "plan_id" in r.json()["error"]
+
+
+def test_speed_test_streams_exact_bytes(grid):
+    import requests
+
+    url = grid.node_url("alice") + "/model-centric/speed-test"
+    r = requests.get(
+        url,
+        params={"worker_id": "w", "random": "1", "size": str(3 * 1024 * 1024 + 7)},
+        timeout=30,
+        stream=True,
+    )
+    assert r.status_code == 200
+    total = sum(len(c) for c in r.iter_content(1 << 16))
+    assert total == 3 * 1024 * 1024 + 7
+
+
+def test_foreign_client_runs_list_variant_with_numpy(grid, hosted):
+    """The tfjs-analog path end-to-end: download the hosted plan as the
+    portable 'list' dialect over HTTP and execute it with numpy only —
+    what a non-XLA edge client would do (reference get-plan
+    receive_operations_as, routes.py:228-233)."""
+    from pygrid_tpu.plans.translators import run_oplist
+
+    client = FLClient(grid.node_url("alice"), auth_token=_token())
+    auth = client.authenticate(NAME, VERSION)
+    wid = auth["worker_id"]
+    cyc = client.cycle_request(wid, NAME, VERSION, 1.0, 1000.0, 1000.0)
+    assert cyc["status"] == "accepted"
+    params = client.get_model(wid, cyc["request_key"], cyc["model_id"])
+    oplist = client.get_plan(
+        wid, cyc["request_key"], cyc["plans"]["training_plan"],
+        receive_operations_as="list",
+    )
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, B)]
+    out = run_oplist(
+        oplist, X, y, np.float32(0.1),
+        *[np.asarray(p) for p in params], backend="numpy",
+    )
+    ref = hosted["plan"](X, y, np.float32(0.1), *[np.asarray(p) for p in params])
+    for a, b in zip(ref, out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+    client.close()

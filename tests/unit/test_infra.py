@@ -96,3 +96,39 @@ def test_cli_direct_dry_run(tmp_path, capsys):
     configs = list((tmp_path / ".pygrid_tpu" / "cli").glob("config_*.json"))
     assert len(configs) == 1
     assert json.load(open(configs[0]))["app"]["name"] == "network"
+
+
+def test_checked_in_stacks_match_builders():
+    """deploy/<stack>/* are rendered by the live provider builders —
+    regeneration must be a no-op (the reference's hand-written HCL can
+    drift from its builders; these cannot)."""
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[2]
+    spec = importlib.util.spec_from_file_location(
+        "regenerate", root / "deploy" / "regenerate.py"
+    )
+    regen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(regen)
+    for stack in regen.STACKS:
+        rendered = regen.render_stack(stack)
+        for fname, contents in rendered.items():
+            on_disk = (root / "deploy" / stack / fname).read_text()
+            assert on_disk == contents, f"deploy/{stack}/{fname} drifted"
+
+
+def test_cli_dry_run_flag(tmp_path, capsys):
+    """`pygrid-tpu deploy --provider gcp --app node --dry-run` writes the
+    terraform configs without applying (VERDICT item #6)."""
+    rc = cli_main([
+        "deploy", "--dry-run", "--provider", "gcp", "--app", "node",
+        "--id", "alice", "--root-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Deployment successful" in out
+    tf = tmp_path / ".pygrid_tpu" / "api" / "gcp-serverfull" / "main.tf.json"
+    assert tf.exists()
+    doc = json.load(open(tf))
+    assert "google_tpu_v2_vm" in doc["resource"]

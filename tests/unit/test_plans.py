@@ -158,3 +158,32 @@ def test_oplist_dialect_executes_training_plan(training_plan):
     out = run_oplist(oplist, *args)
     for a, b in zip(ref, out):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_oplist_numpy_backend_runs_training_plan(training_plan):
+    """A client with ONLY numpy — no jax, no XLA — can execute the hosted
+    grad-traced training plan from the wire dialect and match the compiled
+    output (VERDICT item #7: the tfjs-analog portable variant must be
+    executable, reference plan_manager.py:119-149)."""
+    oplist = serde.deserialize(serde.serialize(translate_plan(training_plan, "list")))
+    params = _mlp_params()
+    X = np.random.RandomState(3).randn(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[np.arange(8) % 10]
+    args = (X, y, np.float32(0.1), *[np.asarray(p) for p in params])
+    ref = training_plan(*args)
+    out = run_oplist(oplist, *args, backend="numpy")
+    for a, b in zip(ref, out):
+        assert type(np.asarray(b)) is np.ndarray
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_oplist_numpy_backend_unknown_op_is_typed_error():
+    from pygrid_tpu.utils.exceptions import PlanTranslationError
+
+    bogus = {
+        "constvars": [], "consts": [], "invars": [0],
+        "eqns": [{"op": "no_such_op", "in": [{"var": 0}], "out": [1], "params": {}}],
+        "outvars": [{"var": 1}],
+    }
+    with pytest.raises(PlanTranslationError, match="no_such_op"):
+        run_oplist(bogus, np.ones(2), backend="numpy")
