@@ -200,10 +200,15 @@ class _NodeServer:
             self._thread.join(timeout=10)
 
 
-def bench_protocol() -> dict:
+def bench_protocol(wire: str = "json") -> dict:
     """W concurrent FLClients through the full cycle protocol against a
     live node (SURVEY §3.3 steps 3-7: the path the reference serves with
-    Flask/gevent + SQLAlchemy + torch serde)."""
+    Flask/gevent + SQLAlchemy + torch serde).
+
+    ``wire="json"`` is the reference-compatible base64-in-JSON contract;
+    ``wire="binary"`` is this framework's msgpack frames with bf16
+    payloads (the ``--wire bf16`` worker CLI path) — both modes hit the
+    same node, same events, same aggregation."""
     import numpy as np
 
     import jax
@@ -214,6 +219,7 @@ def bench_protocol() -> dict:
     from pygrid_tpu.plans.state import serialize_model_params
 
     W, R = PROTO_WORKERS, PROTO_CYCLES
+    bf16 = wire == "binary"
     name, version = "bench-mnist", "1.0"
     server = _NodeServer().start()
     try:
@@ -253,7 +259,7 @@ def bench_protocol() -> dict:
 
         def worker(idx: int) -> None:
             try:
-                client = FLClient(server.url, timeout=PROTO_DEADLINE)
+                client = FLClient(server.url, timeout=PROTO_DEADLINE, wire=wire)
                 auth = client.authenticate(name, version)
                 wid = auth["worker_id"]
                 while (
@@ -267,7 +273,8 @@ def bench_protocol() -> dict:
                         time.sleep(0.05)  # cycle full/aggregating — retry
                         continue
                     model_params = client.get_model(
-                        wid, cyc["request_key"], cyc["model_id"]
+                        wid, cyc["request_key"], cyc["model_id"],
+                        precision="bf16" if bf16 else None,
                     )
                     _plan = client.get_plan(
                         wid, cyc["request_key"],
@@ -279,10 +286,10 @@ def bench_protocol() -> dict:
                     diff = [
                         0.01 * np.asarray(p) for p in model_params
                     ]
-                    blob = serialize_model_params(diff)
+                    blob = serialize_model_params(diff, bf16=bf16)
                     client.report(wid, cyc["request_key"], blob)
                     bytes_reported[idx] += len(
-                        base64.b64encode(blob)
+                        blob if bf16 else base64.b64encode(blob)
                     )
                     cycles_done[idx] += 1
                 client.close()
@@ -304,17 +311,20 @@ def bench_protocol() -> dict:
         if errors:
             print(f"protocol errors: {errors[:3]}", file=sys.stderr)
         print(
-            f"protocol: {W} workers × {R} cycles in {wall:.2f}s — "
+            f"protocol[{wire}]: {W} workers × {R} cycles in {wall:.2f}s — "
             f"{R/wall:.2f} full-cycles/sec, "
             f"{total_updates/wall:.1f} worker-updates/sec, "
             f"{sum(bytes_reported)/wall/1e6:.1f} MB/s diff ingest "
             f"({completed}/{W} workers completed)",
             file=sys.stderr,
         )
+        suffix = "" if wire == "json" else f"_{wire}"
         return {
-            "protocol_full_cycles_per_sec": round(R / wall, 3),
-            "protocol_worker_updates_per_sec": round(total_updates / wall, 1),
-            "protocol_diff_ingest_mb_per_sec": round(
+            f"protocol_full_cycles_per_sec{suffix}": round(R / wall, 3),
+            f"protocol_worker_updates_per_sec{suffix}": round(
+                total_updates / wall, 1
+            ),
+            f"protocol_diff_ingest_mb_per_sec{suffix}": round(
                 sum(bytes_reported) / wall / 1e6, 1
             ),
             "protocol_workers": W,
@@ -325,7 +335,8 @@ def bench_protocol() -> dict:
 
 def main() -> None:
     tpu_rps, mfu = bench_tpu()
-    proto = bench_protocol()
+    proto = bench_protocol("json")
+    proto.update(bench_protocol("binary"))
     cpu_rps = bench_cpu_torch_baseline()
     result = {
         "metric": "fedavg_rounds_per_sec_1k_clients",

@@ -67,8 +67,18 @@ class GridWSClient:
 
     # ── request/response ────────────────────────────────────────────────────
 
-    def send_json(self, msg_type: str, data: Any = None, **top_level) -> dict:
-        """One JSON round-trip; request_id correlates the response."""
+    def _request(
+        self,
+        msg_type: str,
+        data: Any,
+        top_level: dict,
+        encode: Any,
+        decode: Any,
+        want_bytes: bool,
+    ) -> dict:
+        """One event round-trip: frame, send, then read frames of the
+        matching kind until the request_id correlates (frames of the other
+        kind on the same socket belong to other traffic)."""
         self.connect()
         request_id = uuid.uuid4().hex
         message: dict[str, Any] = {
@@ -79,14 +89,32 @@ class GridWSClient:
             message[MSG_FIELD.DATA] = data
         message.update(top_level)
         with self._lock:
-            self._ws.send(json.dumps(message))
+            self._ws.send(encode(message))
             while True:
                 raw = self._ws.recv(timeout=self.timeout)
-                if isinstance(raw, bytes):
-                    continue  # stray binary frame: not ours
-                response = json.loads(raw)
-                if response.get(MSG_FIELD.REQUEST_ID) in (None, request_id):
+                if isinstance(raw, bytes) is not want_bytes:
+                    continue  # stray frame of the other kind: not ours
+                response = decode(raw)
+                if isinstance(response, dict) and response.get(
+                    MSG_FIELD.REQUEST_ID
+                ) in (None, request_id):
                     return response
+
+    def send_json(self, msg_type: str, data: Any = None, **top_level) -> dict:
+        """One JSON round-trip; request_id correlates the response."""
+        return self._request(
+            msg_type, data, top_level, json.dumps, json.loads, want_bytes=False
+        )
+
+    def send_msg_binary(self, msg_type: str, data: Any = None, **top_level) -> dict:
+        """One msgpack-framed event round-trip — the binary twin of
+        :meth:`send_json`. Payload bytes (e.g. FL diffs) travel raw: no
+        base64 inflation, no megabyte JSON parse on either side."""
+        from pygrid_tpu.serde import deserialize, serialize
+
+        return self._request(
+            msg_type, data, top_level, serialize, deserialize, want_bytes=True
+        )
 
     def send_binary(self, blob: bytes) -> bytes:
         """One binary round-trip (syft wire messages)."""

@@ -49,6 +49,9 @@ class FLJob:
         self.plans: dict[str, Any] = {}
         self.client_config: dict = {}
         self.timeout: int | None = None  # retry window on reject
+        #: worker-side override; otherwise the hosted process's
+        #: client_config["diff_precision"] decides
+        self.diff_precision: str | None = None
 
     def add_listener(self, event: str, callback: Callable) -> None:
         self._listeners[event].append(callback)
@@ -79,7 +82,10 @@ class FLJob:
                 self.client_config = cycle.get(CYCLE.CLIENT_CONFIG) or {}
                 model_id = cycle[MSG_FIELD.MODEL_ID]
                 self.model_params = self.client.get_model(
-                    self.worker_id, self.request_key, model_id
+                    self.worker_id,
+                    self.request_key,
+                    model_id,
+                    precision=self.client_config.get("model_precision"),
                 )
                 self.plans = {
                     name: self.client.get_plan(
@@ -100,31 +106,48 @@ class FLJob:
         When the hosted process sets ``client_config["diff_precision"] =
         "bf16"`` the diff travels as bfloat16 — half the upload bytes, the
         dtype the aggregation runs in on TPU anyway."""
-        bf16 = self.client_config.get("diff_precision") == "bf16"
-        blob = serialize_model_params(list(diff_params), bf16=bf16)
+        precision = self.diff_precision or self.client_config.get("diff_precision")
+        blob = serialize_model_params(list(diff_params), bf16=precision == "bf16")
         return self.client.report(self.worker_id, self.request_key, blob)
 
 
 class FLClient:
+    """``wire="json"`` speaks the reference's base64-in-JSON contract
+    (syft.js-era clients pin it); ``wire="binary"`` speaks the msgpack twin
+    — raw diff bytes, bf16 payload floats — for clients built against this
+    framework. Same events, same node, one semantic."""
+
     def __init__(
         self,
         url: str,
         auth_token: str | None = None,
         verbose: bool = False,
         timeout: float = 60.0,
+        wire: str = "json",
     ) -> None:
+        if wire not in ("json", "binary"):
+            raise ValueError("wire must be 'json' or 'binary'")
         self.ws = GridWSClient(url, timeout=timeout)
         self.address = self.ws.address
         self.auth_token = auth_token
         self.verbose = verbose
+        self.wire = wire
+        # plans are immutable per id once hosted (PlanManager stores the
+        # variants at host time), so refetching across cycles is pure waste
+        self._plan_cache: dict[tuple[int, str], Any] = {}
 
     def new_job(self, model_name: str, model_version: str | None = None) -> FLJob:
         return FLJob(self, model_name, model_version)
 
+    def _send_event(self, msg_type: str, data: dict) -> dict:
+        if self.wire == "binary":
+            return self.ws.send_msg_binary(msg_type, data=data)
+        return self.ws.send_json(msg_type, data=data)
+
     # ── protocol steps ─────────────────────────────────────────────────────
 
     def authenticate(self, model_name: str, model_version: str | None) -> dict:
-        response = self.ws.send_json(
+        response = self._send_event(
             MODEL_CENTRIC_FL_EVENTS.AUTHENTICATE,
             data={
                 "auth_token": self.auth_token,
@@ -163,7 +186,7 @@ class FLClient:
         download: float,
         upload: float,
     ) -> dict:
-        response = self.ws.send_json(
+        response = self._send_event(
             MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST,
             data={
                 MSG_FIELD.WORKER_ID: worker_id,
@@ -177,15 +200,25 @@ class FLClient:
         return response.get(MSG_FIELD.DATA, response)
 
     def get_model(
-        self, worker_id: str, request_key: str, model_id: int
+        self,
+        worker_id: str,
+        request_key: str,
+        model_id: int,
+        precision: str | None = None,
     ) -> list:
+        """Download the current checkpoint. ``precision="bf16"`` asks the
+        node to re-encode float32 params as bfloat16 on the way out — half
+        the download, the dtype client training runs in on TPU anyway."""
+        params = {
+            "worker_id": worker_id,
+            "request_key": request_key,
+            "model_id": str(model_id),
+        }
+        if precision:
+            params["precision"] = precision
         resp = requests.get(
             f"{self.address}/model-centric/get-model",
-            params={
-                "worker_id": worker_id,
-                "request_key": request_key,
-                "model_id": str(model_id),
-            },
+            params=params,
             timeout=60,
         )
         if resp.status_code != 200:
@@ -199,6 +232,9 @@ class FLClient:
         plan_id: int,
         receive_operations_as: str = "xla",
     ) -> Any:
+        cached = self._plan_cache.get((plan_id, receive_operations_as))
+        if cached is not None:
+            return cached
         resp = requests.get(
             f"{self.address}/model-centric/get-plan",
             params={
@@ -211,15 +247,22 @@ class FLClient:
         )
         if resp.status_code != 200:
             raise PyGridError(resp.text)
-        return deserialize(resp.content)
+        plan = deserialize(resp.content)
+        self._plan_cache[(plan_id, receive_operations_as)] = plan
+        return plan
 
     def report(self, worker_id: str, request_key: str, diff_blob: bytes) -> dict:
-        response = self.ws.send_json(
+        diff: Any = (
+            diff_blob
+            if self.wire == "binary"
+            else base64.b64encode(diff_blob).decode()
+        )
+        response = self._send_event(
             MODEL_CENTRIC_FL_EVENTS.REPORT,
             data={
                 MSG_FIELD.WORKER_ID: worker_id,
                 CYCLE.KEY: request_key,
-                CYCLE.DIFF: base64.b64encode(diff_blob).decode(),
+                CYCLE.DIFF: diff,
             },
         )
         return response.get(MSG_FIELD.DATA, response)

@@ -183,6 +183,7 @@ class ModelManager:
     def __init__(self, db: Database) -> None:
         self._models = Warehouse(S.Model, db)
         self._checkpoints = Warehouse(S.ModelCheckPoint, db)
+        self._bf16_cache: dict[int, bytes] = {}
 
     def create(self, model_params_blob: bytes, process: S.FLProcess) -> S.Model:
         model = self._models.register(
@@ -211,6 +212,28 @@ class ModelManager:
         if ckpt is None:
             raise E.CheckPointNotFound()
         return ckpt
+
+    def load_encoded(self, model_id: int, precision: str | None = None) -> bytes:
+        """Latest checkpoint blob, optionally re-encoded bf16 for the wire
+        (half the download bytes). Checkpoints are immutable per id, so the
+        bf16 encoding is computed once per checkpoint, not per worker —
+        every assigned worker downloads the same bytes."""
+        ckpt = self.load(model_id=model_id)
+        if precision != "bf16":
+            return ckpt.value
+        cached = self._bf16_cache.get(ckpt.id)
+        if cached is None:
+            from pygrid_tpu.plans.state import (
+                serialize_model_params,
+                unserialize_model_params,
+            )
+
+            cached = serialize_model_params(
+                unserialize_model_params(ckpt.value), bf16=True
+            )
+            self._bf16_cache.clear()  # only the live checkpoint gets traffic
+            self._bf16_cache[ckpt.id] = cached
+        return cached
 
 
 class WorkerManager:

@@ -73,6 +73,9 @@ class NodeContext:
         self.local_worker.crypto_provider = self.crypto_provider
 
         self.fl = FLController(self.db)
+        # a restarted node resumes mid-process from SQL (reference posture,
+        # SURVEY §5.4); deadlined open cycles need their timers re-armed
+        self.fl.cycle_manager.recover_deadlines()
         self.models = ModelController(self.kv)
         self.sessions = SessionsRepository()
         self.users = UserManager(self.db, secret_key=self.secret_key)

@@ -204,7 +204,11 @@ def report(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     data = message.get(MSG_FIELD.DATA) or {}
     response: dict[str, Any] = {}
     try:
-        diff = base64.b64decode((data.get(CYCLE.DIFF) or "").encode())
+        raw = data.get(CYCLE.DIFF) or b""
+        # JSON framing carries the diff base64'd (reference wire contract,
+        # fl_events.py:237-271); binary msgpack framing carries raw bytes —
+        # no +33% inflation, no megabyte JSON parse
+        diff = base64.b64decode(raw.encode()) if isinstance(raw, str) else bytes(raw)
         ctx.fl.submit_diff(
             data.get(MSG_FIELD.WORKER_ID), data.get(CYCLE.KEY), diff
         )
@@ -272,9 +276,14 @@ def socket_ping(ctx: NodeContext, message: dict, conn: Connection) -> dict:
 
 
 def forward_binary_message(
-    ctx: NodeContext, message: bytes | bytearray, conn: Connection
+    ctx: NodeContext,
+    message: bytes | bytearray,
+    conn: Connection,
+    decoded: Any = None,
 ) -> bytes:
-    """(reference syft_events.py:18-45) binary wire msg → per-user worker."""
+    """(reference syft_events.py:18-45) binary wire msg → per-user worker.
+    ``decoded`` carries the already-deserialized message when the WS
+    dispatcher peeked at the frame (one decode per frame, not two)."""
     if conn.session is None:
         return serialize(
             {"error_type": "AuthorizationError", "message": "login required"}
@@ -282,6 +291,8 @@ def forward_binary_message(
     worker = conn.worker
     if len(worker.store) == 0:
         recover_objects(worker, ctx.kv)
+    if decoded is not None:
+        return worker.recv_decoded_msg(decoded, user=conn.session.username)
     return worker._recv_msg(bytes(message), user=conn.session.username)
 
 
@@ -398,12 +409,28 @@ def route_requests(
     ctx: NodeContext, message: str | bytes | bytearray, conn: Connection
 ):
     """(reference events/__init__.py:61-87) one message in, one response out.
-    Binary frames route to the per-user worker; JSON dispatches on `type`;
-    request_id echoes back."""
+    Binary frames carrying a ``{type: ...}`` dict are the msgpack twins of
+    the JSON events (the fast wire for FL reports: raw diff bytes, no
+    base64, no megabyte JSON parse); any other binary frame routes to the
+    per-user worker as before. JSON dispatches on `type`; request_id echoes
+    back in either framing."""
     import json
 
     if isinstance(message, (bytes, bytearray)):
-        return forward_binary_message(ctx, message, conn)
+        try:
+            parsed = deserialize(message)
+        except Exception:  # noqa: BLE001 — let the worker frame the error
+            return forward_binary_message(ctx, message, conn)
+        if isinstance(parsed, dict) and parsed.get(MSG_FIELD.TYPE) in ROUTES:
+            request_id = parsed.get(MSG_FIELD.REQUEST_ID)
+            try:
+                response = ROUTES[parsed[MSG_FIELD.TYPE]](ctx, parsed, conn)
+            except Exception as err:  # noqa: BLE001 — protocol boundary
+                response = {ERROR: str(err)}
+            if request_id:
+                response[MSG_FIELD.REQUEST_ID] = request_id
+            return serialize(response)
+        return forward_binary_message(ctx, message, conn, decoded=parsed)
 
     request_id = None
     try:

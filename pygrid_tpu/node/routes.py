@@ -155,9 +155,11 @@ async def mc_get_model(request: web.Request) -> web.Response:
         model_id = int(_require_query(request, "model_id")[0])
         model = ctx.fl.model_manager.get(id=model_id)
         _validated_cycle(ctx, request, model.fl_process_id)
-        checkpoint = ctx.fl.model_manager.load(model_id=model_id)
+        blob = ctx.fl.model_manager.load_encoded(
+            model_id, precision=request.query.get("precision")
+        )
         return web.Response(
-            body=checkpoint.value, content_type="application/octet-stream"
+            body=blob, content_type="application/octet-stream"
         )
     except Exception as err:  # noqa: BLE001 — HTTP boundary
         return _json_error(err, _status_for(err))

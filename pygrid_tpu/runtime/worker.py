@@ -109,6 +109,18 @@ class VirtualWorker:
         """
         try:
             msg = deserialize(blob)
+        except Exception as err:  # noqa: BLE001 — transport boundary
+            return serialize(
+                M.ErrorResponse(error_type=type(err).__name__, message=str(err))
+            )
+        return self.recv_decoded_msg(msg, user=user)
+
+    def recv_decoded_msg(self, msg: Any, user: str | None = None) -> bytes:
+        """Dispatch an already-deserialized message; same error framing as
+        ``_recv_msg`` (the WS endpoint decodes each binary frame once to
+        multiplex FL events vs. tensor messages — node/events.py — and hands
+        the decoded object straight here)."""
+        try:
             response = self.recv_obj_msg(msg, user=user)
         except E.EmptyCryptoPrimitiveStoreError as err:
             response = M.ErrorResponse(

@@ -5,13 +5,15 @@ Parity surface: the reference's ``Warehouse(schema)`` generic ORM wrapper
 register/query/first/last/count/contains/delete/modify/update over any
 SQLAlchemy schema). Here schemas are plain dataclasses (no SQLAlchemy in the
 image); column DDL is derived from dataclass field types, dict fields are
-stored as serde blobs (the reference's PickleType analog), and one
-``Database`` owns a thread-safe sqlite3 connection (in-memory by default —
-the reference's test posture — or a file for durability).
+stored as serde blobs (the reference's PickleType analog). ``Database`` is
+in-memory by default (the reference's test posture) or file-backed for
+durability — file databases run WAL with per-thread connections so the
+node's concurrent executor threads don't serialize through one lock.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import datetime as dt
 import sqlite3
@@ -72,24 +74,115 @@ def _decode(value: Any, py_type: Any) -> Any:
     return value
 
 
+class _Result:
+    """Materialized query result (cursor-shaped: fetchone/fetchall/lastrowid).
+    Rows are fetched before the backing connection is released, so results
+    never alias a connection another thread may be using."""
+
+    __slots__ = ("_rows", "lastrowid", "_i")
+
+    def __init__(self, rows: list, lastrowid: int | None) -> None:
+        self._rows = rows
+        self.lastrowid = lastrowid
+        self._i = 0
+
+    def fetchall(self) -> list:
+        rows, self._i = self._rows[self._i :], len(self._rows)
+        return rows
+
+    def fetchone(self):
+        if self._i >= len(self._rows):
+            return None
+        row = self._rows[self._i]
+        self._i += 1
+        return row
+
+    def __iter__(self):
+        return iter(self.fetchall())
+
+
 class Database:
-    """One sqlite connection + the table registry, shared by all warehouses."""
+    """The sqlite handle shared by all warehouses.
+
+    File-backed databases get **WAL + one connection per thread**: readers
+    never block behind the writer, and concurrent report/readiness/checkpoint
+    traffic from the node's executor threads doesn't serialize through one
+    process-wide lock. In-memory databases (the test/bench posture) keep a
+    single connection behind an RLock — WAL doesn't exist for ``:memory:``
+    and sqlite shared-cache's table-level SQLITE_LOCKED errors (which ignore
+    ``busy_timeout``) are strictly worse than a short lock under the GIL.
+    """
+
+    #: connections kept warm for reuse; beyond this, a released connection
+    #: closes instead of pooling (bounds fds regardless of thread churn —
+    #: short-lived task threads would otherwise leak one fd each)
+    POOL_SIZE = 8
 
     def __init__(self, url: str = ":memory:") -> None:
         if url.startswith("sqlite://"):
             url = url[len("sqlite://") :].lstrip("/") or ":memory:"
-        self._conn = sqlite3.connect(url, check_same_thread=False)
-        self._conn.row_factory = sqlite3.Row
-        self._lock = threading.RLock()
+        self._url = url
+        self._is_memory = url == ":memory:"
+        self._pool: list[sqlite3.Connection] = []
+        self._pool_lock = threading.Lock()
+        if self._is_memory:
+            self._conn = sqlite3.connect(url, check_same_thread=False)
+            self._conn.row_factory = sqlite3.Row
+            self._lock: threading.RLock | None = threading.RLock()
+        else:
+            self._conn = None
+            self._lock = None
+            with self._connection() as _:
+                pass  # probe: fail fast on an unopenable path
 
-    def execute(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
-        with self._lock:
-            cur = self._conn.execute(sql, params)
-            self._conn.commit()
-            return cur
+    def _new_connection(self) -> sqlite3.Connection:
+        # check_same_thread=False: the pool hands each connection to exactly
+        # one thread at a time (sqlite objects are fine serially cross-thread)
+        conn = sqlite3.connect(self._url, timeout=30.0, check_same_thread=False)
+        conn.row_factory = sqlite3.Row
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        conn.execute("PRAGMA busy_timeout=30000")
+        return conn
+
+    @contextlib.contextmanager
+    def _connection(self) -> Iterator[sqlite3.Connection]:
+        with self._pool_lock:
+            conn = self._pool.pop() if self._pool else None
+        if conn is None:
+            conn = self._new_connection()
+        try:
+            yield conn
+        finally:
+            with self._pool_lock:
+                keep = len(self._pool) < self.POOL_SIZE
+                if keep:
+                    self._pool.append(conn)
+            if not keep:
+                conn.close()
+
+    def execute(self, sql: str, params: tuple = ()) -> "_Result":
+        if self._is_memory:
+            with self._lock:
+                cur = self._conn.execute(sql, params)
+                self._conn.commit()
+                return _Result(cur.fetchall() if cur.description else [], cur.lastrowid)
+        with self._connection() as conn:
+            # materialize before the connection returns to the pool —
+            # a live cursor on a re-leased connection is a data race
+            cur = conn.execute(sql, params)
+            rows = cur.fetchall() if cur.description else []
+            lastrowid = cur.lastrowid
+            conn.commit()
+            return _Result(rows, lastrowid)
 
     def close(self) -> None:
-        self._conn.close()
+        if self._conn is not None:
+            self._conn.close()
+        with self._pool_lock:
+            for conn in self._pool:
+                conn.close()
+            self._pool.clear()
 
 
 class Warehouse:

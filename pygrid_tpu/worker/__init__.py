@@ -45,22 +45,26 @@ def run_worker(
     data_fn: Callable[[int], tuple] = default_data_fn,
     cycles: int = 1,
     max_retry_wait: float = 30.0,
+    wire: str = "json",
+    diff_precision: str | None = None,
 ) -> WorkerResult:
     """Participate in up to ``cycles`` FL cycles: authenticate → cycle
     request → download model+plan → local plan execution → report diff.
     A *rejected* cycle carries a retry window the node expects the worker
     to honor (reference fl_controller.py:160-172) — we sleep it (capped at
-    ``max_retry_wait``) before the next request."""
+    ``max_retry_wait``) before the next request. ``wire="binary"`` switches
+    the event transport to msgpack frames with raw/bf16 diff payloads."""
     import time
 
     from pygrid_tpu.client.fl_client import FLClient
 
     result = WorkerResult()
-    client = FLClient(node_url, auth_token=auth_token)
+    client = FLClient(node_url, auth_token=auth_token, wire=wire)
     try:
         for _ in range(cycles):
             retry_wait = [0.0]
             job = client.new_job(model_name, model_version)
+            job.diff_precision = diff_precision
 
             def on_accepted(job: Any) -> None:
                 plan = job.plans["training_plan"]

@@ -16,6 +16,14 @@ def main(argv=None) -> int:
     parser.add_argument("--model-version", default=None)
     parser.add_argument("--auth-token", default=None)
     parser.add_argument("--cycles", type=int, default=1)
+    parser.add_argument(
+        "--wire",
+        choices=("json", "binary", "bf16"),
+        default="json",
+        help="event transport: json (syft.js-compatible base64 wire), "
+        "binary (msgpack frames, raw diff bytes), bf16 (binary + bfloat16 "
+        "diff payloads)",
+    )
     args = parser.parse_args(argv)
 
     from pygrid_tpu.worker import run_worker
@@ -26,6 +34,8 @@ def main(argv=None) -> int:
         model_version=args.model_version,
         auth_token=args.auth_token,
         cycles=args.cycles,
+        wire="binary" if args.wire in ("binary", "bf16") else "json",
+        diff_precision="bf16" if args.wire == "bf16" else None,
     )
     print(
         f"worker done: accepted={result.accepted} rejected={result.rejected} "

@@ -259,3 +259,66 @@ def test_foreign_client_runs_list_variant_with_numpy(grid, hosted):
     for a, b in zip(ref, out):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
     client.close()
+
+
+def test_binary_wire_full_round(grid):
+    """The msgpack wire twin (FLClient(wire="binary") + bf16 payloads): a
+    full cycle over binary WS frames — raw diff bytes, bf16 model download
+    — lands the same aggregation the JSON wire does. (The JSON contract
+    stays for syft.js-era clients; this is the fast path the worker CLI's
+    ``--wire bf16`` selects.)"""
+    name, version = "mnist-binwire", "1.0"
+    params, plan = make_plans_and_params()
+    mc = ModelCentricFLClient(grid.node_url("bob"))
+    response = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": name,
+            "version": version,
+            "batch_size": B,
+            "lr": 0.1,
+            "max_updates": 2,
+            "diff_precision": "bf16",
+            "model_precision": "bf16",
+        },
+        server_config={
+            "min_workers": 2,
+            "max_workers": 2,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+            "num_cycles": 1,
+            "max_diffs": 2,
+            "min_diffs": 2,
+        },
+    )
+    assert response.get("status") == "success"
+
+    diffs = []
+    for k in range(2):
+        client = FLClient(grid.node_url("bob"), wire="binary")
+        auth = client.authenticate(name, version)
+        assert auth.get("status") == "success", auth
+        wid = auth["worker_id"]
+        cyc = client.cycle_request(wid, name, version, 1.0, 100.0, 100.0)
+        assert cyc["status"] == "accepted", cyc
+        model_params = client.get_model(
+            wid, cyc["request_key"], cyc["model_id"], precision="bf16"
+        )
+        # bf16 download decodes to float32 within bf16 resolution
+        for orig, got in zip(params, model_params):
+            np.testing.assert_allclose(orig, got, atol=2e-2, rtol=1e-2)
+        diff = [np.full_like(p, 0.25 * (k + 1)) for p in model_params]
+        diffs.append(diff)
+        blob = __import__(
+            "pygrid_tpu.plans.state", fromlist=["serialize_model_params"]
+        ).serialize_model_params(diff, bf16=True)
+        rep = client.report(wid, cyc["request_key"], blob)
+        assert rep.get("status") == "success", rep
+        client.close()
+
+    latest = mc.retrieve_model(name, version)
+    mean_diff = [np.mean([d[i] for d in diffs], axis=0) for i in range(len(params))]
+    for new, orig, d in zip(latest, params, mean_diff):
+        np.testing.assert_allclose(new, orig - d, atol=2e-2, rtol=1e-2)
+    mc.close()

@@ -352,10 +352,10 @@ def test_auth_expired_token():
 
 
 def test_aggregation_scales_to_256_diffs():
-    """One cycle ingesting 256 worker diffs: the stacked-mean path must
-    stage all diffs as one [K, ...] device buffer per parameter and produce
-    the exact average (the scaling case the reference's per-diff reduce
-    loop, cycle_manager.py:275-290, cannot batch)."""
+    """One cycle ingesting 256 worker diffs: the submit-time accumulator
+    folds each into the running f64 sum, so completion is a divide and the
+    result is the exact average (the scaling case the reference's per-diff
+    f32 reduce loop, cycle_manager.py:275-290, degrades on)."""
     K = 256
     db = Database(":memory:")
     ctl = FLController(db)
@@ -395,3 +395,152 @@ def test_aggregation_scales_to_256_diffs():
     np.testing.assert_allclose(
         np.asarray(new[1]), params[1] - mean_diff, rtol=1e-4
     )
+
+
+def test_deadline_completes_cycle_without_further_reports():
+    """min_diffs reached, remaining workers vanish: the deadline timer armed
+    at cycle creation closes the cycle within ~1s of ``cycle.end`` with no
+    further protocol event. The reference only re-checks readiness inside
+    submit_worker_diff (cycle_manager.py:180-217), so its cycle would hang."""
+    import time
+
+    db = Database(":memory:")
+    ctl = FLController(db)
+    params = _model_params()
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": _training_plan()},
+        name="mnist-deadline",
+        version="1.0",
+        client_config=dict(CLIENT_CONFIG, name="mnist-deadline"),
+        server_config=dict(
+            SERVER_CONFIG,
+            min_diffs=1,
+            max_diffs=5,
+            min_workers=1,
+            max_workers=5,
+            cycle_length=1,  # seconds
+            num_cycles=1,
+        ),
+    )
+    w = _register_worker(ctl, "early-bird")
+    resp = ctl.assign("mnist-deadline", "1.0", w)
+    assert resp[CYCLE.STATUS] == CYCLE.ACCEPTED
+    diff = [np.full((10, 4), 0.5, np.float32), np.full((4,), 0.5, np.float32)]
+    ctl.submit_diff("early-bird", resp[CYCLE.KEY], serialize_model_params(diff))
+    cycle = ctl.cycle_manager._cycles.first(is_completed=False)
+    assert cycle is not None, "cycle must stay open until the deadline"
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        cycle = ctl.cycle_manager._cycles.first(id=cycle.id)
+        if cycle.is_completed:
+            break
+        time.sleep(0.05)
+    assert cycle.is_completed, "deadline timer did not close the cycle"
+    # the single received diff became the aggregate
+    latest = ctl.model_manager.load(model_id=resp["model_id"], alias="latest")
+    new = unserialize_model_params(latest.value)
+    np.testing.assert_allclose(np.asarray(new[0]), params[0] - 0.5, rtol=1e-5)
+
+
+def test_recover_deadlines_rearms_after_restart():
+    """A node restarted mid-cycle re-arms deadline timers from SQL
+    (recover_deadlines is called by NodeContext init)."""
+    import time
+
+    db = Database(":memory:")
+    ctl = FLController(db)
+    params = _model_params()
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": _training_plan()},
+        name="mnist-recover",
+        version="1.0",
+        client_config=dict(CLIENT_CONFIG, name="mnist-recover"),
+        server_config=dict(
+            SERVER_CONFIG, min_diffs=1, max_diffs=5, min_workers=1,
+            cycle_length=1, num_cycles=1,
+        ),
+    )
+    w = _register_worker(ctl, "w-restart")
+    resp = ctl.assign("mnist-recover", "1.0", w)
+    diff = [np.zeros((10, 4), np.float32), np.zeros(4, np.float32)]
+    ctl.submit_diff("w-restart", resp[CYCLE.KEY], serialize_model_params(diff))
+    # simulate restart: drop the live timer, then recover from SQL
+    cycle = ctl.cycle_manager._cycles.first(is_completed=False)
+    timer = ctl.cycle_manager._deadline_timers.pop(cycle.id)
+    timer.cancel()
+    ctl.cycle_manager.recover_deadlines()
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        if ctl.cycle_manager._cycles.first(id=cycle.id).is_completed:
+            break
+        time.sleep(0.05)
+    assert ctl.cycle_manager._cycles.first(id=cycle.id).is_completed
+
+
+def test_accumulator_matches_blob_rebuild():
+    """The streaming accumulator and the restart path (rebuild from stored
+    blobs) must agree exactly: drop the accumulator mid-cycle and the
+    aggregate is unchanged."""
+    db = Database(":memory:")
+    ctl = FLController(db)
+    params = _model_params()
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": _training_plan()},
+        name="mnist-acc",
+        version="1.0",
+        client_config=dict(CLIENT_CONFIG, name="mnist-acc"),
+        server_config=dict(SERVER_CONFIG, num_cycles=1),
+    )
+    rng = np.random.RandomState(3)
+    diffs = []
+    for k in range(2):
+        w = _register_worker(ctl, f"acc-{k}")
+        resp = ctl.assign("mnist-acc", "1.0", w)
+        d = [rng.randn(10, 4).astype(np.float32), rng.randn(4).astype(np.float32)]
+        diffs.append(d)
+        if k == 0:
+            ctl.submit_diff(f"acc-{k}", resp[CYCLE.KEY], serialize_model_params(d))
+            # "restart": the in-memory accumulator is lost
+            ctl.cycle_manager._accum.clear()
+        else:
+            ctl.submit_diff(f"acc-{k}", resp[CYCLE.KEY], serialize_model_params(d))
+    latest = ctl.model_manager.load(model_id=resp["model_id"], alias="latest")
+    new = unserialize_model_params(latest.value)
+    expected = params[0] - np.mean([d[0] for d in diffs], axis=0)
+    np.testing.assert_allclose(np.asarray(new[0]), expected, rtol=1e-5)
+
+
+def test_deadline_with_zero_diffs_closes_cycle_without_checkpoint():
+    """No min_diffs + nobody reports: the deadline closes the cycle with
+    the model unchanged (no checkpoint written) and spawns the next cycle —
+    averaging nothing must not crash the timer thread."""
+    import time
+
+    db = Database(":memory:")
+    ctl = FLController(db)
+    params = _model_params()
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": _training_plan()},
+        name="mnist-empty",
+        version="1.0",
+        client_config=dict(CLIENT_CONFIG, name="mnist-empty"),
+        server_config={
+            "min_workers": 1, "max_workers": 5, "cycle_length": 1,
+            "num_cycles": 2,
+        },
+    )
+    first = ctl.cycle_manager._cycles.first(is_completed=False)
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        if ctl.cycle_manager._cycles.first(id=first.id).is_completed:
+            break
+        time.sleep(0.05)
+    assert ctl.cycle_manager._cycles.first(id=first.id).is_completed
+    # model untouched, next cycle spawned
+    model = ctl.model_manager.get(fl_process_id=1)
+    assert ctl.model_manager.load(model_id=model.id, alias="latest").number == 1
+    assert ctl.cycle_manager.last(1).sequence == 2

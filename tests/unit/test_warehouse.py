@@ -73,3 +73,38 @@ def test_null_filter(db):
     wh = Warehouse(S.Cycle, db)
     wh.register(fl_process_id=1, sequence=1, version="", end=None)
     assert wh.count(end=None) == 1
+
+
+def test_file_backed_wal_concurrent_threads(tmp_path):
+    """File databases run WAL with one connection per thread: concurrent
+    writers/readers from many threads (the node's executor pool) must not
+    serialize through a process lock or corrupt rows."""
+    import threading
+
+    from pygrid_tpu.storage.warehouse import Database, Warehouse
+
+    db = Database(str(tmp_path / "grid.db"))
+    wh = Warehouse(S.FLProcess, db)
+    # WAL is actually on
+    mode = db.execute("PRAGMA journal_mode").fetchone()[0]
+    assert mode == "wal"
+
+    N_THREADS, N_EACH = 8, 25
+    errors = []
+
+    def writer(t):
+        try:
+            for i in range(N_EACH):
+                wh.register(name=f"t{t}-{i}", version="1.0")
+                wh.count(name=f"t{t}-{i}")
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert wh.count() == N_THREADS * N_EACH
+    db.close()
