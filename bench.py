@@ -140,6 +140,92 @@ def bench_cpu_torch_baseline() -> float:
     return 1.0 / (per_client * K)
 
 
+def bench_smpc() -> dict:
+    """3-party fixed-prec Beaver matmul batches, two kernel tiers on the
+    same chip: the vmapped batch path (`smpc.kernels.batched_beaver`) and
+    the mesh-sharded party-axis path (`smpc.sharded`, 1-device mesh here;
+    the party axis becomes cross-chip collectives on a slice). Chained
+    launches + one final fetch (tunnel-safe marginal timing)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from pygrid_tpu.smpc import ring as R
+    from pygrid_tpu.smpc.kernels import batched_beaver, share_kernel
+    from pygrid_tpu.smpc.sharded import (
+        deal_triples,
+        make_sharded_beaver,
+    )
+
+    B, Pn, N = 512, 3, 64
+    key = jax.random.PRNGKey(0)
+    x = jax.random.bits(key, (B, N, N), dtype=jnp.uint32)
+    x_r = R.Ring64(x, jnp.zeros_like(x))
+    # vmap layout [B, P, N, N]
+    vm_sh = jax.vmap(lambda v: share_kernel(key, v, Pn))(x_r)
+
+    def chain_vmap(n):
+        @jax.jit
+        def run(k, s):
+            for i in range(n):
+                s = batched_beaver(jax.random.fold_in(k, i), s, s)
+            return s
+        return run
+
+    def chain_sharded(n):
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("parties",))
+        combine = make_sharded_beaver(mesh, op="matmul")
+
+        @jax.jit
+        def run(k, s):
+            for i in range(n):
+                a_sh, b_sh, c_sh = deal_triples(
+                    jax.random.fold_in(k, i), (N, N), (N, N), Pn,
+                    op="matmul", batch=B,
+                )
+                s = combine(s, s, a_sh, b_sh, c_sh)
+            return s
+        return run
+
+    # sharded layout [P, B, N, N]
+    sh_sh = R.Ring64(
+        jnp.moveaxis(vm_sh.lo, 1, 0), jnp.moveaxis(vm_sh.hi, 1, 0)
+    )
+
+    results = {}
+    for name, make, arg in (
+        ("vmap", chain_vmap, vm_sh),
+        ("sharded", chain_sharded, sh_sh),
+    ):
+        small, large = 1, 9
+        fns = {n: make(n) for n in (small, large)}
+
+        def run_once(n):
+            t0 = time.perf_counter()
+            out = fns[n](key, arg)
+            # slice on device, fetch ONE element — a full-array fetch
+            # would drag ~25MB through the tunnel and drown the signal
+            _ = int(out.lo[0, 0, 0, 0])
+            return time.perf_counter() - t0
+
+        for n in fns:
+            run_once(n)  # compile
+        t_small = min(run_once(small) for _ in range(5))
+        t_large = min(run_once(large) for _ in range(5))
+        per = (t_large - t_small) / (large - small)
+        results[name] = B / per
+        print(
+            f"smpc[{name}]: {per*1e3:.2f} ms per {B}-batch {Pn}-party "
+            f"Beaver {N}x{N} matmul round ({B*Pn/per:,.0f} parties/sec)",
+            file=sys.stderr,
+        )
+    return {
+        "smpc_beaver_matmuls_per_sec_vmap": round(results["vmap"], 0),
+        "smpc_beaver_matmuls_per_sec_sharded": round(results["sharded"], 0),
+    }
+
+
 # --- protocol plane ----------------------------------------------------------
 
 
@@ -337,6 +423,7 @@ def main() -> None:
     tpu_rps, mfu = bench_tpu()
     proto = bench_protocol("json")
     proto.update(bench_protocol("binary"))
+    proto.update(bench_smpc())
     cpu_rps = bench_cpu_torch_baseline()
     result = {
         "metric": "fedavg_rounds_per_sec_1k_clients",

@@ -206,10 +206,12 @@ class CycleManager:
         if self._uses_fallback_mean(cycle.fl_process_id):
             # fold into the running sum now — aggregation work rides each
             # report instead of spiking at cycle completion (the blob is
-            # still stored above: parity surface + restart recovery)
+            # still stored above: parity surface + restart recovery).
+            # Decode outside the lock: only the cheap fold serializes.
+            decoded = unserialize_model_params(diff)
             with self._accum_lock:
                 acc = self._accum.setdefault(cycle.id, _DiffAccumulator())
-                acc.add(unserialize_model_params(diff))
+                acc.add(decoded)
             fresh = self._cycles.first(id=cycle.id)
             if fresh is not None and fresh.is_completed:
                 # lost the race with completion (it rebuilt from blobs);

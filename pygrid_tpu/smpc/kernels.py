@@ -8,8 +8,10 @@ parties) per launch — the TPU-native answer to the reference's
 one-process-per-party grid (SURVEY.md §2.5, BASELINE.md north star).
 
 Layouts: shares are ``Ring64`` with leading axes ``[B?, P, ...]`` where P is
-the party axis. "Opening" a masked value is a sum over P — on a sharded mesh
-this lowers to a ``psum`` over the party mesh axis instead of socket traffic.
+the party axis. "Opening" a masked value is a sum over P — the mesh-sharded
+variant of these kernels (:mod:`pygrid_tpu.smpc.sharded`) puts P on a
+``Mesh`` axis via ``shard_map`` and opens with an exact collective
+(:func:`pygrid_tpu.smpc.ring.ring_psum`) instead of socket traffic.
 """
 
 from __future__ import annotations

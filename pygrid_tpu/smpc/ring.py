@@ -312,3 +312,37 @@ def ring_random(key: jax.Array, shape) -> Ring64:
     lo = jax.random.bits(k1, shape, dtype=jnp.uint32)
     hi = jax.random.bits(k2, shape, dtype=jnp.uint32)
     return Ring64(lo, hi)
+
+
+# --- collective ring sum (the mesh-sharded "open") ---------------------------
+
+
+def ring_psum(
+    r: Ring64, axis_name: str, local_axis: int | None = 0
+) -> Ring64:
+    """Exact sum mod 2^64 over ``local_axis`` *and* the mesh axis
+    ``axis_name`` — the collective "open" for shares sharded over a party
+    mesh axis (call inside ``shard_map``).
+
+    A plain ``psum`` of the (lo, hi) u32 limbs would drop inter-limb
+    carries (carry propagation is not linear, so it cannot ride the
+    collective). Instead each 64-bit share splits into four 16-bit
+    half-limbs held in u32; those sums are carry-free for up to 2^16
+    parties (limb sum ≤ P·(2^16−1) < 2^32), so the psum is exact, and the
+    carries are propagated once, locally, after the collective.
+    """
+    limbs = [
+        r.lo & _MASK16,
+        r.lo >> 16,
+        r.hi & _MASK16,
+        r.hi >> 16,
+    ]
+    if local_axis is not None:
+        limbs = [l.sum(axis=local_axis, dtype=U32) for l in limbs]
+    limbs = [lax.psum(l, axis_name) for l in limbs]
+    out, carry = [], None
+    for l in limbs:
+        c = l if carry is None else l + carry
+        out.append(c & _MASK16)
+        carry = c >> 16
+    return Ring64(out[0] | (out[1] << 16), out[2] | (out[3] << 16))

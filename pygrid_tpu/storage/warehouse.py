@@ -153,13 +153,20 @@ class Database:
             conn = self._new_connection()
         try:
             yield conn
-        finally:
-            with self._pool_lock:
-                keep = len(self._pool) < self.POOL_SIZE
-                if keep:
-                    self._pool.append(conn)
-            if not keep:
+        except BaseException:
+            # never re-pool a connection mid-transaction: the next borrower
+            # would silently commit (or read inside) the failed statement
+            try:
+                conn.rollback()
+            finally:
                 conn.close()
+            raise
+        with self._pool_lock:
+            keep = len(self._pool) < self.POOL_SIZE
+            if keep:
+                self._pool.append(conn)
+        if not keep:
+            conn.close()
 
     def execute(self, sql: str, params: tuple = ()) -> "_Result":
         if self._is_memory:
