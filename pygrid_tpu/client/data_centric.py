@@ -161,6 +161,21 @@ class DataCentricFLClient:
             },
         )
 
+    def download_model(self, model_id: str) -> Any:
+        """Fetch a hosted model/plan blob (requires ``allow_download`` on the
+        hosted model and a session token)."""
+        import requests
+
+        resp = requests.get(
+            f"{self.address}/data-centric/serve-model/",
+            params={"model_id": model_id},
+            headers={"token": self._auth_token or ""},
+            timeout=self.ws.timeout,
+        )
+        if resp.status_code != 200:
+            raise PyGridError(resp.text)
+        return deserialize(resp.content)
+
     def run_remote_inference(self, model_id: str, data: Any) -> Any:
         response = self.ws.send_json(
             REQUEST_MSG.RUN_INFERENCE,

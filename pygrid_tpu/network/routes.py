@@ -167,7 +167,22 @@ async def search_encrypted_model(request: web.Request) -> web.Response:
         nodes, "/data-centric/search-encrypted-models", "post", body
     )
     match_nodes = {
-        nid: {"address": nodes[nid], "nodes": payload}
+        nid: {
+            "address": nodes[nid],
+            "nodes": payload,
+            # share-holders/providers that are themselves grid nodes get
+            # their addresses resolved here, so a client can dial them
+            # without out-of-band knowledge (the reference assumes the
+            # client already knows the grid map; this is strictly more)
+            "worker_addresses": {
+                wid: nodes[wid]
+                for wid in (
+                    payload.get("workers", [])
+                    + payload.get("crypto_provider", [])
+                )
+                if wid in nodes
+            },
+        }
         for nid, payload in results.items()
         if {"workers", "crypto_provider"} <= set(payload.keys())
     }

@@ -333,6 +333,29 @@ async def dc_workers(request: web.Request) -> web.Response:
     return web.json_response({"workers": workers})
 
 
+async def dc_download_model(request: web.Request) -> web.Response:
+    """GET twin of serve-model: the hosted blob back out, gated on the
+    model's ``allow_download`` flag and a session token (the flag the
+    reference's ModelStorage carries for exactly this purpose)."""
+    ctx = _ctx(request)
+    try:
+        _dc_session(request)
+        model_id = _require_query(request, "model_id")[0]
+        hosted = ctx.models.get(ctx.local_worker.id, model_id)
+        if not hosted.allow_download:
+            raise E.AuthorizationError(
+                "You're not allowed to download this model."
+            )
+        from pygrid_tpu.serde import serialize
+
+        blob = hosted.serialized or serialize(hosted.model)
+        return web.Response(
+            body=blob, content_type="application/octet-stream"
+        )
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
 async def dc_serve_model(request: web.Request) -> web.Response:
     """(reference routes.py:128-169) host a model over HTTP; multipart for
     big payloads or JSON with base64 body."""
@@ -374,11 +397,13 @@ async def dc_dataset_tags(request: web.Request) -> web.Response:
     return web.json_response(sorted(tags))
 
 
-def _find_shared_tensors(value: Any) -> list[AdditiveSharingTensor]:
-    """Descend a hosted model / plan state to its AdditiveSharingTensors
-    (reference routes.py:192-250 walks Plan.state tensor chains)."""
+def _find_shared_tensors(value: Any) -> list[Any]:
+    """Descend a hosted model / plan state to its shared tensors — live
+    AdditiveSharingTensors or SharedTensorRef wiring metadata; both carry
+    ``owners``/``crypto_provider_id``. (Reference routes.py:192-250 walks
+    Plan.state tensor chains the same way.)"""
     found = []
-    if isinstance(value, AdditiveSharingTensor):
+    if hasattr(value, "owners") and hasattr(value, "crypto_provider_id"):
         found.append(value)
     elif isinstance(value, Plan) and value.state is not None:
         for t in value.state.tensors():
@@ -480,6 +505,7 @@ def register(app: web.Application) -> None:
     r.add_get("/data-centric/status/", dc_status)
     r.add_get("/data-centric/workers/", dc_workers)
     r.add_post("/data-centric/serve-model/", dc_serve_model)
+    r.add_get("/data-centric/serve-model/", dc_download_model)
     r.add_get("/data-centric/dataset-tags", dc_dataset_tags)
     r.add_post("/data-centric/search-encrypted-models", dc_search_encrypted_models)
     r.add_post("/data-centric/search", dc_search)

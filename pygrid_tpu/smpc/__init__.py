@@ -25,3 +25,14 @@ from pygrid_tpu.smpc.remote import (  # noqa: F401
     fix_prec_share_to_nodes,
     share_to_nodes,
 )
+from pygrid_tpu.smpc.encrypted_model import (  # noqa: F401
+    EncryptedModel,
+    SharedTensorRef,
+    publish_encrypted_model,
+    run_encrypted_oplist,
+)
+from pygrid_tpu.smpc.sharded import (  # noqa: F401
+    make_sharded_beaver,
+    make_sharded_open,
+    sharded_beaver,
+)

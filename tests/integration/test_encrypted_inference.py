@@ -1,0 +1,129 @@
+"""End-to-end encrypted inference across the grid — the reference's flagship
+§3.5 flow composed: publish (weights fix-prec shared over alice/bob/charlie,
+dan deals Beaver triples) → discover via Network /search-encrypted-model →
+run the hosted Plan's op-list where every matmul/mul is a cross-node Beaver
+round → reconstruct the prediction client-side → compare to plaintext.
+
+Reference call stack: network.py:157-198 (fan-out search) →
+routes/data_centric/routes.py:192-250 (share-holder walk) →
+events/data_centric/model_events.py:21-129 (inference) — SURVEY §3.5.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.client import DataCentricFLClient
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.smpc import EncryptedModel, publish_encrypted_model
+
+MODEL_ID = "encrypted-mlp"
+D_IN, D_H, D_OUT, B = 4, 3, 2, 2
+
+
+def _forward(x, w1, b1, w2, b2):
+    """CryptoNets-style MLP: affine → square → affine (polynomial activation
+    — data-dependent nonlinearities need comparison protocols, SURVEY §2.4)."""
+    h = x @ w1 + b1
+    h = h * h
+    return h @ w2 + b2
+
+
+def _weights():
+    rng = np.random.default_rng(11)
+    return [
+        rng.uniform(-0.5, 0.5, (D_IN, D_H)).astype(np.float32),
+        rng.uniform(-0.2, 0.2, (D_H,)).astype(np.float32),
+        rng.uniform(-0.5, 0.5, (D_H, D_OUT)).astype(np.float32),
+        rng.uniform(-0.2, 0.2, (D_OUT,)).astype(np.float32),
+    ]
+
+
+@pytest.fixture(scope="module")
+def published(grid):
+    """Model owner: share weights over alice/bob/charlie (dan = provider),
+    serve the plan on alice with mpc=True."""
+    weights = _weights()
+    plan = Plan(name="encrypted_forward", fn=_forward)
+    plan.build(np.zeros((B, D_IN), np.float32), *weights)
+
+    alice = DataCentricFLClient(grid.node_url("alice"))
+    bob = DataCentricFLClient(grid.node_url("bob"))
+    charlie = DataCentricFLClient(grid.node_url("charlie"))
+    dan = DataCentricFLClient(grid.node_url("dan"))
+    publish_encrypted_model(
+        plan,
+        MODEL_ID,
+        host_client=alice,
+        holder_clients=[alice, bob, charlie],
+        provider_client=dan,
+        weights=weights,
+    )
+    yield {"weights": weights}
+    for c in (alice, bob, charlie, dan):
+        c.close()
+
+
+def test_discovery_reports_holders_and_provider(grid, published):
+    import requests
+
+    resp = requests.post(
+        grid.network_url + "/search-encrypted-model",
+        json={"model_id": MODEL_ID},
+        timeout=15,
+    )
+    match = resp.json()["match-nodes"]
+    assert "alice" in match
+    info = match["alice"]
+    assert set(info["nodes"]["workers"]) == {"alice", "bob", "charlie"}
+    assert info["nodes"]["crypto_provider"] == ["dan"]
+    # the network resolves share-holder addresses so clients can dial them
+    assert set(info["worker_addresses"]) == {"alice", "bob", "charlie", "dan"}
+    for addr in info["worker_addresses"].values():
+        assert addr.startswith("http")
+
+
+def test_encrypted_inference_end_to_end(grid, published):
+    """The flagship: discover → connect → Beaver-matmul inference →
+    client-side reconstruction ≈ plaintext forward pass."""
+    model = EncryptedModel.discover(grid.network_url, MODEL_ID)
+    try:
+        rng = np.random.default_rng(5)
+        x = rng.uniform(-1, 1, (B, D_IN)).astype(np.float32)
+        pred = model.predict(x)
+        expected = _forward(x, *published["weights"])
+        # fixed-point scale 1e-3 and two truncations bound the error
+        np.testing.assert_allclose(pred, expected, atol=5e-2)
+        assert pred.shape == (B, D_OUT)
+    finally:
+        model.close()
+
+
+def test_no_single_node_holds_the_secret(grid, published):
+    """Each node's share of w1 decodes to noise, not the weight."""
+    model = EncryptedModel.discover(grid.network_url, MODEL_ID)
+    try:
+        w1 = published["weights"][0]
+        for ptr in model.weights[0].pointers:
+            share = np.asarray(ptr.get(delete=False)).astype(np.int64)
+            assert not np.allclose(share / 1000.0, w1, atol=1e-2)
+    finally:
+        model.close()
+
+
+def test_download_requires_allow_download_flag(grid, published):
+    """A served model without allow_download answers 400/401 on download."""
+    from pygrid_tpu.utils.exceptions import PyGridError
+
+    bob = DataCentricFLClient(grid.node_url("bob"))
+    bob.serve_model(
+        Plan(name="private", fn=lambda x: x * 2.0).build(
+            np.zeros((1, 2), np.float32)
+        ),
+        "private-model",
+        allow_download=False,
+    )
+    with pytest.raises(PyGridError):
+        bob.download_model("private-model")
+    bob.close()

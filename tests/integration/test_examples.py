@@ -58,3 +58,9 @@ def test_data_centric_train_example():
     result = _run("data_centric/02_train_model.py", "--spawn")
     assert result.returncode == 0, result.stderr
     assert "max |w - w*|" in result.stdout
+
+
+def test_encrypted_inference_example():
+    result = _run("encrypted_inference.py", "--spawn")
+    assert result.returncode == 0, result.stderr
+    assert "encrypted inference OK" in result.stdout

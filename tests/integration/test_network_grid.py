@@ -226,3 +226,69 @@ def test_network_rbac_http_twins(grid):
         grid.network_url + "/users/", headers={"token": "junk"}, timeout=10
     )
     assert r.status_code == 400
+
+
+def test_network_driven_model_centric_hosting_flow(grid):
+    """Compose the network-driven hosting path (reference network.py:134-154):
+    ask the Network to choose a model host, host the FL process on the
+    chosen node, then drive one full cycle through it — host selection and
+    cycle execution as one flow, not two tested halves."""
+    import numpy as np
+
+    from pygrid_tpu.client import FLClient, ModelCentricFLClient
+    from pygrid_tpu.models import mlp
+    from pygrid_tpu.plans.plan import Plan
+
+    node_id, address = requests.get(
+        grid.network_url + "/choose-model-host", timeout=10
+    ).json()[0]
+    assert node_id in {"alice", "bob", "charlie", "dan"}
+
+    D, H, C, B = 12, 6, 3, 4
+    import jax
+
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(0), (D, H, C))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(address)
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": "net-chosen", "version": "1.0",
+            "batch_size": B, "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": 1, "max_workers": 1,
+            "min_diffs": 1, "max_diffs": 1, "num_cycles": 1,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+        },
+    )
+    assert resp.get("status") == "success", resp
+
+    # one worker drives the full cycle on the network-chosen node
+    from pygrid_tpu.plans.state import serialize_model_params
+
+    client = FLClient(address)
+    auth = client.authenticate("net-chosen", "1.0")
+    wid = auth["worker_id"]
+    cyc = client.cycle_request(wid, "net-chosen", "1.0", 1.0, 100.0, 100.0)
+    assert cyc["status"] == "accepted", cyc
+    model_params = client.get_model(wid, cyc["request_key"], cyc["model_id"])
+    diff = [0.1 * np.asarray(p) for p in model_params]
+    rep = client.report(wid, cyc["request_key"], serialize_model_params(diff))
+    assert rep.get("status") == "success", rep
+    client.close()
+
+    latest = mc.retrieve_model("net-chosen", "1.0")
+    for new, orig, d in zip(latest, params, diff):
+        np.testing.assert_allclose(new, orig - d, rtol=1e-5)
+    mc.close()
