@@ -49,8 +49,19 @@ def _flops_per_round() -> float:
     return 6.0 * K * BATCH * dots
 
 
-def bench_tpu() -> tuple[float, float]:
-    """Returns (rounds/sec, mfu_fraction)."""
+def bench_tpu() -> tuple[float, float, float]:
+    """Returns (rounds/sec folded, mfu_fraction, rounds/sec per-client).
+
+    Two kernel shapes of the same algorithm (identical outputs — the
+    identity is tested in test_fedavg_sim.py):
+
+    - *per-client*: vmapped clients, per-client diffs materialized then
+      meaned — bandwidth-bound on the [K, 784, 392] diff tensor
+      (~2.5 GB/round of HBM traffic at K=1024).
+    - *folded* (``fold_clients=True``): K·B samples fold into one batch
+      before the first matmul, so the round writes ONE weight update —
+      the roofline moves from bandwidth- to compute-bound (BASELINE.md).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -66,40 +77,48 @@ def bench_tpu() -> tuple[float, float]:
 
     # single-pass bf16 MXU dots with f32 accumulation — measured ~5% over
     # the platform default at these sizes, accuracy-neutral for FedAvg
-    def scanned(n: int):
+    def scanned(n: int, fold: bool):
         return make_scanned_rounds(
             mlp.training_step,
             n_rounds=n,
             local_steps=1,
             matmul_precision="BF16_BF16_F32",
+            fold_clients=fold,
         )
 
     small_n, large_n = 5, 5 + TIMED_ROUNDS
-    fns = {n: scanned(n) for n in (small_n, large_n)}
-    for n, fn in fns.items():  # compile both programs
-        out = fn(params, client_X, client_y, lr)
-        _ = float(out[1][-1])  # host fetch — on tunneled platforms
-        # block_until_ready returns early; only a fetch truly syncs
 
-    def run(n: int) -> float:
-        t0 = time.perf_counter()
-        final, losses, accs = fns[n](params, client_X, client_y, lr)
-        _ = float(losses[-1])  # single fetch forces the whole chain
-        return time.perf_counter() - t0
+    def measure(fold: bool) -> float:
+        fns = {n: scanned(n, fold) for n in (small_n, large_n)}
+        for n, fn in fns.items():  # compile both programs
+            out = fn(params, client_X, client_y, lr)
+            _ = float(out[1][-1])  # host fetch — on tunneled platforms
+            # block_until_ready returns early; only a fetch truly syncs
 
-    # min over trials: tunnel jitter is one-sided noise on top of the
-    # true execution time
-    t_small = min(run(small_n) for _ in range(3))
-    t_large = min(run(large_n) for _ in range(3))
-    dt = (t_large - t_small) / TIMED_ROUNDS  # marginal: launch+tunnel cancel
-    mfu = _flops_per_round() / dt / (PEAK_TFLOPS * 1e12)
+        def run(n: int) -> float:
+            t0 = time.perf_counter()
+            final, losses, accs = fns[n](params, client_X, client_y, lr)
+            _ = float(losses[-1])  # single fetch forces the whole chain
+            return time.perf_counter() - t0
+
+        # min over trials: tunnel jitter is one-sided noise on top of the
+        # true execution time
+        t_small = min(run(small_n) for _ in range(3))
+        t_large = min(run(large_n) for _ in range(3))
+        return (t_large - t_small) / TIMED_ROUNDS  # marginal timing
+
+    dt_per_client = measure(fold=False)
+    dt_folded = measure(fold=True)
+    mfu = _flops_per_round() / dt_folded / (PEAK_TFLOPS * 1e12)
+    mfu_pc = _flops_per_round() / dt_per_client / (PEAK_TFLOPS * 1e12)
     print(
-        f"tpu: {dt*1e3:.2f} ms/round @ {K} clients "
-        f"({K/dt:,.0f} client-updates/sec, MFU {mfu*100:.1f}% of "
-        f"{PEAK_TFLOPS:.0f} TF bf16)",
+        f"tpu: folded {dt_folded*1e3:.2f} ms/round @ {K} clients "
+        f"({K/dt_folded:,.0f} client-updates/sec, MFU {mfu*100:.1f}%) | "
+        f"per-client {dt_per_client*1e3:.2f} ms/round "
+        f"(MFU {mfu_pc*100:.1f}%) of {PEAK_TFLOPS:.0f} TF bf16",
         file=sys.stderr,
     )
-    return 1.0 / dt, mfu
+    return 1.0 / dt_folded, mfu, 1.0 / dt_per_client
 
 
 def bench_cpu_torch_baseline() -> float:
@@ -420,7 +439,7 @@ def bench_protocol(wire: str = "json") -> dict:
 
 
 def main() -> None:
-    tpu_rps, mfu = bench_tpu()
+    tpu_rps, mfu, tpu_rps_per_client = bench_tpu()
     proto = bench_protocol("json")
     proto.update(bench_protocol("binary"))
     proto.update(bench_smpc())
@@ -431,6 +450,7 @@ def main() -> None:
         "unit": "rounds/sec (1024 simulated MNIST-MLP clients, batch 64)",
         "vs_baseline": round(tpu_rps / cpu_rps, 1),
         "mfu_pct": round(mfu * 100, 1),
+        "fedavg_rounds_per_sec_per_client_path": round(tpu_rps_per_client, 3),
         **proto,
     }
     print(json.dumps(result))

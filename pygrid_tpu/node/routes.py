@@ -28,7 +28,6 @@ from pygrid_tpu.node.events import (
 )
 from pygrid_tpu.plans.plan import Plan
 from pygrid_tpu.serde import deserialize
-from pygrid_tpu.smpc.additive import AdditiveSharingTensor
 from pygrid_tpu.utils import exceptions as E
 from pygrid_tpu.utils.codes import MSG_FIELD
 

@@ -44,6 +44,9 @@ _ARRAY_OPS: dict[str, Callable] = {
     "relu": lambda x: jnp.maximum(x, 0.0),
     "t": lambda x: jnp.swapaxes(x, -1, -2),
     "reshape": lambda x, *s, **k: jnp.reshape(x, s or k.get("shape")),
+    "broadcast_to": lambda x, *s, **k: jnp.broadcast_to(
+        x, tuple(s) or tuple(k.get("shape"))
+    ),
     "argmax": jnp.argmax, "softmax": jax.nn.softmax,
 }
 
@@ -58,6 +61,10 @@ _ARRAY_OPS_I64 = {
     "sum": np.sum,
     "t": lambda x: np.swapaxes(x, -1, -2),
     "reshape": lambda x, *s, **k: np.reshape(x, s or k.get("shape")),
+    # copy: remote results must own their buffers (broadcast views alias)
+    "broadcast_to": lambda x, *s, **k: np.broadcast_to(
+        x, tuple(s) or tuple(k.get("shape"))
+    ).copy(),
 }
 
 # per-type allowlists for method dispatch: everything else is rejected

@@ -161,8 +161,9 @@ def _shared_reshape(t: RemoteSharedTensor, shape: tuple) -> RemoteSharedTensor:
 
 
 def _broadcast_in_dim(t, params) -> Any:
-    """Shape-align for a following (numpy-broadcasting) elementwise op:
-    insert size-1 axes per broadcast_dimensions. Share-local and linear."""
+    """Materialize the broadcast share-locally (linear: broadcasting each
+    additive share broadcasts the secret): insert size-1 axes per
+    broadcast_dimensions, then remote ``broadcast_to`` the full shape."""
     shape = tuple(int(s) for s in params["shape"])
     bdims = tuple(int(d) for d in params["broadcast_dimensions"])
     in_shape = t.shape if isinstance(t, RemoteSharedTensor) else np.shape(t)
@@ -170,7 +171,12 @@ def _broadcast_in_dim(t, params) -> Any:
     for in_ax, out_ax in enumerate(bdims):
         aligned[out_ax] = in_shape[in_ax]
     if isinstance(t, RemoteSharedTensor):
-        return _shared_reshape(t, tuple(aligned))
+        aligned_t = _shared_reshape(t, tuple(aligned))
+        ptrs = [
+            p.remote_op("broadcast_to", shape=list(shape))
+            for p in aligned_t.pointers
+        ]
+        return RemoteSharedTensor(ptrs, t.encoder, t.provider)
     return np.broadcast_to(np.reshape(t, aligned), shape)
 
 
@@ -265,17 +271,33 @@ def run_encrypted_oplist(oplist: dict, args: Sequence[Any]) -> Any:
         )
     for iid, a in zip(oplist["invars"], args):
         env[iid] = a
+    from pygrid_tpu.plans.translators import _CALL_OPS
+
     for eqn in oplist["eqns"]:
-        fn = _SMPC_OPS.get(eqn["op"])
-        if fn is None:
-            raise PyGridError(
-                f"op {eqn['op']!r} has no SMPC lowering (data-dependent "
-                "nonlinearities need comparison protocols; use polynomial "
-                "activations for encrypted inference)"
-            )
         invals = [read(r) for r in eqn["in"]]
-        out = fn(*invals, eqn["params"])
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        if eqn["op"] in _CALL_OPS:
+            # jit/pjit wrapper: recurse into the inner jaxpr (same unwrap
+            # as the plaintext interpreter, translators.py run_oplist)
+            inner = None
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                cand = eqn["params"].get(key)
+                if isinstance(cand, dict) and "__jaxpr__" in cand:
+                    inner = cand["__jaxpr__"]
+                    break
+            if inner is None:
+                raise PyGridError(f"no inner jaxpr for {eqn['op']!r}")
+            out = run_encrypted_oplist(inner, invals)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+        else:
+            fn = _SMPC_OPS.get(eqn["op"])
+            if fn is None:
+                raise PyGridError(
+                    f"op {eqn['op']!r} has no SMPC lowering (data-dependent "
+                    "nonlinearities need comparison protocols; use polynomial "
+                    "activations for encrypted inference)"
+                )
+            out = fn(*invals, eqn["params"])
+            outs = out if isinstance(out, (list, tuple)) else [out]
         for oid, o in zip(eqn["out"], outs):
             env[oid] = o
     results = [read(r) for r in oplist["outvars"]]
@@ -333,12 +355,22 @@ class EncryptedModel:
             json={"model_id": model_id},
             timeout=timeout,
         )
+        if resp.status_code != 200:
+            raise PyGridError(
+                f"encrypted-model search failed ({resp.status_code}): "
+                f"{resp.text[:200]}"
+            )
         match = resp.json().get("match-nodes") or {}
         if not match:
             raise PyGridError(f"no node hosts encrypted model {model_id!r}")
         host_id, info = next(iter(match.items()))
         worker_ids = info["nodes"]["workers"]
         provider_ids = info["nodes"]["crypto_provider"]
+        if not provider_ids:
+            raise PyGridError(
+                f"model {model_id!r} has no crypto provider — its shares "
+                "were placed without one, so Beaver rounds cannot be dealt"
+            )
         addresses = dict(info.get("worker_addresses") or {})
         addresses.setdefault(host_id, info["address"])
         missing = [

@@ -101,3 +101,35 @@ def test_mlp_plan_traceable():
     y = np.eye(10, dtype=np.float32)[np.arange(8) % 10]
     out = plan2(X, y, np.float32(0.1), *[np.asarray(p, np.float32) for p in params])
     assert np.isfinite(float(out[0]))
+
+
+def test_folded_rounds_match_per_client_rounds():
+    """fold_clients=True is the same algorithm reassociated: with one local
+    step, folding K*B samples into one batch must reproduce the per-client
+    path's params and metrics (the identity the kernel-plane roofline
+    optimization rests on)."""
+    from pygrid_tpu.parallel import make_scanned_rounds
+
+    K, B, sizes = 8, 16, (32, 16, 4)
+    params = mlp.init(jax.random.PRNGKey(0), sizes)
+    X, y = _toy_mnist(jax.random.PRNGKey(1), K, B, dim=32, classes=4)
+    lr = jnp.float32(0.3)
+
+    per_client = make_scanned_rounds(mlp.training_step, n_rounds=4)
+    folded = make_scanned_rounds(
+        mlp.training_step, n_rounds=4, fold_clients=True
+    )
+    p1, l1, a1 = per_client(params, X, y, lr)
+    p2, l2, a2 = folded(params, X, y, lr)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2), atol=1e-5)
+
+
+def test_folded_rounds_reject_multiple_local_steps():
+    from pygrid_tpu.parallel import make_scanned_rounds
+
+    with pytest.raises(ValueError, match="local_steps"):
+        make_scanned_rounds(mlp.training_step, n_rounds=2, local_steps=3,
+                            fold_clients=True)
