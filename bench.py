@@ -438,7 +438,37 @@ def bench_protocol(wire: str = "json") -> dict:
         server.stop()
 
 
+#: watchdog: a dark TPU tunnel hangs the first device call forever (observed
+#: in-session: even a 1000x1000 matmul fetch never returns). Rather than the
+#: driver recording nothing, emit an honest JSON line and exit. Generous
+#: default — first TPU compiles are ~20-40s, full bench minutes.
+BENCH_TIMEOUT = float(os.environ.get("PYGRID_BENCH_TIMEOUT", "1500"))
+
+
+def _arm_watchdog() -> threading.Timer:
+    def _fire() -> None:
+        print(
+            json.dumps(
+                {
+                    "metric": "fedavg_rounds_per_sec_1k_clients",
+                    "value": None,
+                    "unit": "rounds/sec (1024 simulated MNIST-MLP clients, batch 64)",
+                    "error": f"bench exceeded {BENCH_TIMEOUT:.0f}s — "
+                    "TPU tunnel unreachable or pathological hang",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    timer = threading.Timer(BENCH_TIMEOUT, _fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main() -> None:
+    watchdog = _arm_watchdog()
     tpu_rps, mfu, tpu_rps_per_client = bench_tpu()
     proto = bench_protocol("json")
     proto.update(bench_protocol("binary"))
@@ -453,6 +483,7 @@ def main() -> None:
         "fedavg_rounds_per_sec_per_client_path": round(tpu_rps_per_client, 3),
         **proto,
     }
+    watchdog.cancel()
     print(json.dumps(result))
 
 

@@ -183,7 +183,11 @@ class ModelManager:
     def __init__(self, db: Database) -> None:
         self._models = Warehouse(S.Model, db)
         self._checkpoints = Warehouse(S.ModelCheckPoint, db)
-        self._bf16_cache: dict[int, bytes] = {}
+        #: (model_id, precision) -> (checkpoint_id, wire blob) — per model,
+        #: so concurrently-served processes don't evict each other; the
+        #: hot download path skips the sqlite megabyte row read entirely
+        self._blob_cache: dict[tuple[int, str], tuple[int, bytes]] = {}
+        self._latest_ckpt: dict[int, int] = {}
 
     def create(self, model_params_blob: bytes, process: S.FLProcess) -> S.Model:
         model = self._models.register(
@@ -203,9 +207,13 @@ class ModelManager:
         model_manager.py:30-50)."""
         self._checkpoints.modify({"model_id": model_id, "alias": "latest"}, {"alias": ""})
         number = self._checkpoints.count(model_id=model_id) + 1
-        return self._checkpoints.register(
+        ckpt = self._checkpoints.register(
             value=blob, model_id=model_id, number=number, alias="latest"
         )
+        self._latest_ckpt[model_id] = ckpt.id
+        self._blob_cache[(model_id, "f32")] = (ckpt.id, blob)
+        self._blob_cache.pop((model_id, "bf16"), None)
+        return ckpt
 
     def load(self, **filters: Any) -> S.ModelCheckPoint:
         ckpt = self._checkpoints.last(**filters)
@@ -215,25 +223,31 @@ class ModelManager:
 
     def load_encoded(self, model_id: int, precision: str | None = None) -> bytes:
         """Latest checkpoint blob, optionally re-encoded bf16 for the wire
-        (half the download bytes). Checkpoints are immutable per id, so the
-        bf16 encoding is computed once per checkpoint, not per worker —
-        every assigned worker downloads the same bytes."""
+        (half the download bytes). Checkpoints are immutable per id, so
+        every worker in a cycle downloads the same bytes — the blob (and
+        its bf16 re-encoding) is read/computed once per checkpoint, not
+        per worker: at K workers per cycle the sqlite megabyte read would
+        otherwise repeat K times."""
+        key = (model_id, precision or "f32")
+        latest = self._latest_ckpt.get(model_id)
+        entry = self._blob_cache.get(key)
+        if latest is not None and entry is not None and entry[0] == latest:
+            return entry[1]
         ckpt = self.load(model_id=model_id)
-        if precision != "bf16":
-            return ckpt.value
-        cached = self._bf16_cache.get(ckpt.id)
-        if cached is None:
+        self._latest_ckpt[model_id] = ckpt.id
+        if precision == "bf16":
             from pygrid_tpu.plans.state import (
                 serialize_model_params,
                 unserialize_model_params,
             )
 
-            cached = serialize_model_params(
+            blob = serialize_model_params(
                 unserialize_model_params(ckpt.value), bf16=True
             )
-            self._bf16_cache.clear()  # only the live checkpoint gets traffic
-            self._bf16_cache[ckpt.id] = cached
-        return cached
+        else:
+            blob = ckpt.value
+        self._blob_cache[key] = (ckpt.id, blob)
+        return blob
 
 
 class WorkerManager:

@@ -81,6 +81,28 @@ async def join(request: web.Request) -> web.Response:
         )
 
 
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus text exposition for the Network (nodes + proxy states) —
+    the node app serves its own /metrics; the reference has neither
+    (SURVEY §5.5)."""
+    ctx = _ctx(request)
+    from pygrid_tpu.utils.metrics import Exposition
+
+    exp = Exposition()
+    nodes = ctx.manager.connected_nodes()
+    exp.gauge("grid_nodes_total", len(nodes),
+              "nodes registered with the network")
+    by_status: dict[str, int] = {}
+    for proxy in ctx.proxies.values():
+        by_status[proxy.status] = by_status.get(proxy.status, 0) + 1
+    for status in ("online", "busy", "offline"):
+        exp.gauge("grid_nodes", by_status.get(status, 0),
+                  "nodes by monitor status", {"status": status})
+    return web.Response(
+        text=exp.render(), content_type="text/plain", charset="utf-8"
+    )
+
+
 async def connected_nodes(request: web.Request) -> web.Response:
     nodes = _ctx(request).manager.connected_nodes()
     return web.json_response({"grid-nodes": list(nodes.keys())})
@@ -287,6 +309,7 @@ def register(app: web.Application) -> None:
     r = app.router
     r.add_post("/join", join)
     r.add_get("/connected-nodes", connected_nodes)
+    r.add_get("/metrics", metrics)
     r.add_delete("/delete-node", delete_node)
     r.add_get("/choose-encrypted-model-host", choose_encrypted_model_host)
     r.add_get("/choose-model-host", choose_model_host)

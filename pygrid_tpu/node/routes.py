@@ -326,6 +326,47 @@ async def dc_status(request: web.Request) -> web.Response:
     )
 
 
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus text exposition of the node's state and timings — beyond
+    parity: the reference has no structured metrics at all (SURVEY §5.5,
+    its observability is the 15s monitor JSON). Scrape ``/metrics``."""
+    ctx = _ctx(request)
+    from pygrid_tpu.utils.metrics import Exposition
+    from pygrid_tpu.utils.profiling import stats
+
+    exp = Exposition()
+    fl = ctx.fl
+    exp.counter("workers_total", fl.worker_manager._workers.count(),
+                "FL workers ever registered")
+    exp.gauge("fl_processes", fl.process_manager._processes.count(),
+              "hosted FL processes")
+    exp.counter("cycles_total", fl.cycle_manager._cycles.count(),
+                "cycles created")
+    exp.gauge(
+        "cycles_open",
+        fl.cycle_manager._cycles.count(is_completed=False),
+        "cycles awaiting diffs",
+    )
+    exp.counter(
+        "worker_diffs_total",
+        fl.cycle_manager._worker_cycles.count(is_completed=True),
+        "diffs received",
+    )
+    exp.gauge("hosted_models", len(ctx.models.models(ctx.local_worker.id)),
+              "data-centric hosted models")
+    exp.gauge("store_objects", sum(len(s) for s in ctx.all_stores()),
+              "objects across tensor stores")
+    for name, rec in stats.snapshot().items():
+        labels = {"name": name}
+        exp.counter("timing_seconds_total", rec["total_s"],
+                    "cumulative seconds per timed section", labels)
+        exp.counter("timing_invocations_total", rec["count"],
+                    "invocations per timed section", labels)
+    return web.Response(
+        text=exp.render(), content_type="text/plain", charset="utf-8"
+    )
+
+
 async def dc_workers(request: web.Request) -> web.Response:
     ctx = _ctx(request)
     workers = [w.id for w in ctx.fl.worker_manager.query()]
@@ -501,6 +542,7 @@ def register(app: web.Application) -> None:
     r.add_get("/data-centric/models/", dc_models)
     r.add_get("/data-centric/detailed-models-list/", dc_detailed_models)
     r.add_get("/data-centric/identity/", dc_identity)
+    r.add_get("/metrics", metrics)
     r.add_get("/data-centric/status/", dc_status)
     r.add_get("/data-centric/workers/", dc_workers)
     r.add_post("/data-centric/serve-model/", dc_serve_model)

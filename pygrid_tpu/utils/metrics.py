@@ -1,0 +1,44 @@
+"""Prometheus text-exposition helper shared by the node and network
+``/metrics`` handlers (the reference has no structured metrics at all —
+SURVEY §5.5)."""
+
+from __future__ import annotations
+
+
+class Exposition:
+    """Collects metric families; one HELP/TYPE per name no matter how many
+    labeled samples (a second HELP line for a name fails the whole
+    Prometheus scrape)."""
+
+    def __init__(self, prefix: str = "pygrid") -> None:
+        self.prefix = prefix
+        self._lines: list[str] = []
+        self._declared: set[str] = set()
+
+    def sample(
+        self,
+        name: str,
+        value,
+        help_: str,
+        labels: dict | None = None,
+        type_: str = "gauge",
+    ) -> None:
+        full = f"{self.prefix}_{name}"
+        if full not in self._declared:
+            self._lines.append(f"# HELP {full} {help_}")
+            self._lines.append(f"# TYPE {full} {type_}")
+            self._declared.add(full)
+        label_str = ""
+        if labels:
+            inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            label_str = "{" + inner + "}"
+        self._lines.append(f"{full}{label_str} {value}")
+
+    def counter(self, name: str, value, help_: str, labels: dict | None = None) -> None:
+        self.sample(name, value, help_, labels, type_="counter")
+
+    def gauge(self, name: str, value, help_: str, labels: dict | None = None) -> None:
+        self.sample(name, value, help_, labels, type_="gauge")
+
+    def render(self) -> str:
+        return "\n".join(self._lines) + "\n"

@@ -322,3 +322,21 @@ def test_binary_wire_full_round(grid):
     for new, orig, d in zip(latest, params, mean_diff):
         np.testing.assert_allclose(new, orig - d, atol=2e-2, rtol=1e-2)
     mc.close()
+
+
+def test_metrics_endpoint(grid, hosted):
+    """Prometheus text exposition: gauges for FL state + timings."""
+    import requests
+
+    r = requests.get(grid.node_url("alice") + "/metrics", timeout=10)
+    assert r.status_code == 200
+    text = r.text
+    assert "# TYPE pygrid_workers_total counter" in text
+    assert "pygrid_fl_processes" in text
+    assert "pygrid_cycles_open" in text
+    # prometheus exposition: every non-comment line is "name{labels} value"
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            name, value = line.rsplit(" ", 1)
+            assert name.startswith("pygrid_")
+            float(value)

@@ -292,3 +292,10 @@ def test_network_driven_model_centric_hosting_flow(grid):
     for new, orig, d in zip(latest, params, diff):
         np.testing.assert_allclose(new, orig - d, rtol=1e-5)
     mc.close()
+
+
+def test_network_metrics_endpoint(grid):
+    r = requests.get(grid.network_url + "/metrics", timeout=10)
+    assert r.status_code == 200
+    assert "pygrid_grid_nodes_total 4" in r.text
+    assert 'pygrid_grid_nodes{status="online"}' in r.text
