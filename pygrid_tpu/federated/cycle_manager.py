@@ -159,6 +159,12 @@ class CycleManager:
             is_completed=False,
         )
 
+    def count_cycles(self, **filters: Any) -> int:
+        return self._cycles.count(**filters)
+
+    def count_worker_cycles(self, **filters: Any) -> int:
+        return self._worker_cycles.count(**filters)
+
     def is_assigned(self, cycle_id: int, worker_id: str) -> bool:
         return self._worker_cycles.contains(cycle_id=cycle_id, worker_id=worker_id)
 

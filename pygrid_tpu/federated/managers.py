@@ -118,6 +118,9 @@ class ProcessManager:
         self.plan_manager = plan_manager
         self.protocol_manager = protocol_manager
 
+    def count(self, **filters: Any) -> int:
+        return self._processes.count(**filters)
+
     def create(
         self,
         name: str,
@@ -211,7 +214,7 @@ class ModelManager:
             value=blob, model_id=model_id, number=number, alias="latest"
         )
         self._latest_ckpt[model_id] = ckpt.id
-        self._blob_cache[(model_id, "f32")] = (ckpt.id, blob)
+        self._cache_put((model_id, "f32"), (ckpt.id, blob))
         self._blob_cache.pop((model_id, "bf16"), None)
         return ckpt
 
@@ -228,7 +231,11 @@ class ModelManager:
         its bf16 re-encoding) is read/computed once per checkpoint, not
         per worker: at K workers per cycle the sqlite megabyte read would
         otherwise repeat K times."""
-        key = (model_id, precision or "f32")
+        # normalize: anything that isn't the bf16 re-encode serves the
+        # stored f32 blob — an attacker-varied query string must not mint
+        # unbounded cache keys
+        precision = "bf16" if precision == "bf16" else "f32"
+        key = (model_id, precision)
         latest = self._latest_ckpt.get(model_id)
         entry = self._blob_cache.get(key)
         if latest is not None and entry is not None and entry[0] == latest:
@@ -246,8 +253,19 @@ class ModelManager:
             )
         else:
             blob = ckpt.value
-        self._blob_cache[key] = (ckpt.id, blob)
+        self._cache_put(key, (ckpt.id, blob))
         return blob
+
+    #: at most this many cached wire blobs (f32+bf16 per actively-served
+    #: model); beyond it the oldest entry evicts — a node that hosted many
+    #: finished processes must not keep their blobs resident forever
+    BLOB_CACHE_MAX = 16
+
+    def _cache_put(self, key: tuple, entry: tuple) -> None:
+        self._blob_cache.pop(key, None)
+        self._blob_cache[key] = entry  # dict order = insertion = LRU-ish
+        while len(self._blob_cache) > self.BLOB_CACHE_MAX:
+            self._blob_cache.pop(next(iter(self._blob_cache)))
 
 
 class WorkerManager:
@@ -256,6 +274,9 @@ class WorkerManager:
 
     def create(self, worker_id: str) -> S.Worker:
         return self._workers.register(id=worker_id)
+
+    def count(self, **filters: Any) -> int:
+        return self._workers.count(**filters)
 
     def get(self, **filters: Any) -> S.Worker:
         worker = self._workers.first(**filters)

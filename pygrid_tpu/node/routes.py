@@ -336,20 +336,20 @@ async def metrics(request: web.Request) -> web.Response:
 
     exp = Exposition()
     fl = ctx.fl
-    exp.counter("workers_total", fl.worker_manager._workers.count(),
+    exp.counter("workers_total", fl.worker_manager.count(),
                 "FL workers ever registered")
-    exp.gauge("fl_processes", fl.process_manager._processes.count(),
+    exp.gauge("fl_processes", fl.process_manager.count(),
               "hosted FL processes")
-    exp.counter("cycles_total", fl.cycle_manager._cycles.count(),
+    exp.counter("cycles_total", fl.cycle_manager.count_cycles(),
                 "cycles created")
     exp.gauge(
         "cycles_open",
-        fl.cycle_manager._cycles.count(is_completed=False),
+        fl.cycle_manager.count_cycles(is_completed=False),
         "cycles awaiting diffs",
     )
     exp.counter(
         "worker_diffs_total",
-        fl.cycle_manager._worker_cycles.count(is_completed=True),
+        fl.cycle_manager.count_worker_cycles(is_completed=True),
         "diffs received",
     )
     exp.gauge("hosted_models", len(ctx.models.models(ctx.local_worker.id)),

@@ -5,6 +5,17 @@ SURVEY §5.5)."""
 from __future__ import annotations
 
 
+def _escape(value) -> str:
+    """Prometheus label-value escaping — one bad value must not corrupt
+    the whole scrape."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class Exposition:
     """Collects metric families; one HELP/TYPE per name no matter how many
     labeled samples (a second HELP line for a name fails the whole
@@ -30,7 +41,9 @@ class Exposition:
             self._declared.add(full)
         label_str = ""
         if labels:
-            inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            inner = ",".join(
+                f'{k}="{_escape(v)}"' for k, v in labels.items()
+            )
             label_str = "{" + inner + "}"
         self._lines.append(f"{full}{label_str} {value}")
 

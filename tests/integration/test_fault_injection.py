@@ -1,0 +1,136 @@
+"""Fault injection — deliberately killing grid members mid-flow.
+
+The reference has NO fault injection anywhere (SURVEY §5.3); its failure
+handling is ad-hoc gates. These tests build a dedicated mini-grid, kill
+real servers, and assert the surviving planes degrade the way the design
+promises: fan-outs skip dead nodes, encrypted inference fails fast with a
+typed error instead of hanging, and the network monitor marks the corpse
+offline."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from pygrid_tpu.client import DataCentricFLClient
+from pygrid_tpu.federated import tasks
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.smpc import EncryptedModel, publish_encrypted_model
+from pygrid_tpu.utils.exceptions import PyGridError
+
+from .conftest import ServerThread, _free_port
+
+NAMES = ["alice", "bob", "charlie", "dan"]
+
+
+@pytest.fixture()
+def mortal_grid():
+    """A per-test grid whose nodes this test is allowed to kill."""
+    from pygrid_tpu.network import create_app as create_network_app
+    from pygrid_tpu.node import create_app as create_node_app
+
+    prev_sync = tasks._sync
+    tasks.set_sync(True)
+    network = ServerThread(
+        create_network_app("chaos-network", monitor_interval=0.2),
+        _free_port(),
+    ).start()
+    nodes: dict[str, ServerThread] = {}
+    for name in NAMES:
+        server = ServerThread(create_node_app(name), _free_port()).start()
+        server.app["node"].address = server.url
+        nodes[name] = server
+        requests.post(
+            network.url + "/join",
+            json={"node-id": name, "node-address": server.url},
+            timeout=10,
+        ).raise_for_status()
+    stopped: set[str] = set()
+
+    class Mortal:
+        network_url = network.url
+
+        def node_url(self, name: str) -> str:
+            return nodes[name].url
+
+        def kill(self, name: str) -> None:
+            stopped.add(name)
+            nodes[name].stop()
+
+    yield Mortal()
+    tasks.set_sync(prev_sync)
+    for name, server in nodes.items():
+        if name not in stopped:
+            server.stop()
+    network.stop()
+
+
+def _forward(x, w):
+    return x @ w
+
+
+def test_search_fanout_skips_dead_node(mortal_grid):
+    """Network fan-outs swallow per-node connection errors (reference
+    network.py:173-175) — a dead node must not take the search down."""
+    mortal_grid.kill("dan")
+    resp = requests.post(
+        mortal_grid.network_url + "/search",
+        json={"query": ["#nothing"]},
+        timeout=20,
+    )
+    assert resp.status_code == 200  # fan-out survived the corpse
+
+
+def test_encrypted_inference_fails_fast_when_holder_dies(mortal_grid):
+    """A share-holder dying between discovery and prediction must surface
+    as a prompt typed error (connection refused propagates through the
+    pointer transport), never a hang or a silently-wrong prediction."""
+    w = np.array([[0.5, -0.25], [1.0, 0.75]], dtype=np.float32)
+    plan = Plan(name="encrypted_forward", fn=_forward)
+    plan.build(np.zeros((1, 2), np.float32), w)
+
+    alice = DataCentricFLClient(mortal_grid.node_url("alice"))
+    bob = DataCentricFLClient(mortal_grid.node_url("bob"))
+    charlie = DataCentricFLClient(mortal_grid.node_url("charlie"))
+    dan = DataCentricFLClient(mortal_grid.node_url("dan"))
+    publish_encrypted_model(
+        plan,
+        "chaos-model",
+        host_client=alice,
+        holder_clients=[alice, bob, charlie],
+        provider_client=dan,
+        weights=[w],
+    )
+    model = EncryptedModel.discover(mortal_grid.network_url, "chaos-model")
+    # sanity: it works while everyone is alive
+    x = np.array([[1.0, 2.0]], dtype=np.float32)
+    np.testing.assert_allclose(model.predict(x), x @ w, atol=5e-2)
+
+    mortal_grid.kill("charlie")
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as err:
+        model.predict(x)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 30, f"failure took {elapsed:.1f}s — should fail fast"
+    assert not isinstance(err.value, AssertionError)
+    model.close()
+    for c in (alice, bob, dan):
+        c.close()
+
+
+def test_monitor_marks_dead_node_offline(mortal_grid):
+    """The network's heartbeat monitor downgrades a killed node to offline
+    (reference marks offline on socket loss, events/socket_handler.py:36-38)."""
+    mortal_grid.kill("bob")
+    deadline = time.monotonic() + 10
+    status = None
+    while time.monotonic() < deadline:
+        r = requests.get(mortal_grid.network_url + "/nodes-status", timeout=10)
+        status = {nid: info["status"] for nid, info in r.json().items()}
+        if status.get("bob") == "offline":
+            break
+        time.sleep(0.3)
+    assert status and status.get("bob") == "offline", status

@@ -340,3 +340,69 @@ def test_metrics_endpoint(grid, hosted):
             name, value = line.rsplit(" ", 1)
             assert name.startswith("pygrid_")
             float(value)
+
+
+def test_cnn_plan_full_cycle(grid):
+    """Second model family through the whole protocol: a conv training plan
+    (NHWC CNN, reference notebook 02's model class) hosts, serves its xla
+    variant, executes on a worker, and aggregates — conv ops surviving the
+    trace → export → wire → execute chain, not just the MLP."""
+    import numpy as np
+
+    import jax
+
+    from pygrid_tpu.models import cnn
+    from pygrid_tpu.plans.state import serialize_model_params
+
+    name, version = "mnist-cnn", "1.0"
+    Bc = 4
+    params = [np.asarray(p) for p in cnn.init(jax.random.PRNGKey(3))]
+    plan = Plan(name="training_plan", fn=cnn.training_step)
+    plan.build(
+        np.zeros((Bc, 28, 28, 1), np.float32),
+        np.zeros((Bc, 10), np.float32),
+        np.float32(0.05),
+        *params,
+    )
+    mc = ModelCentricFLClient(grid.node_url("charlie"))
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": name, "version": version,
+            "batch_size": Bc, "lr": 0.05, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": 1, "max_workers": 1,
+            "min_diffs": 1, "max_diffs": 1, "num_cycles": 1,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+        },
+    )
+    assert resp.get("status") == "success", resp
+
+    client = FLClient(grid.node_url("charlie"), wire="binary")
+    auth = client.authenticate(name, version)
+    wid = auth["worker_id"]
+    cyc = client.cycle_request(wid, name, version, 1.0, 100.0, 100.0)
+    assert cyc["status"] == "accepted", cyc
+    model_params = client.get_model(wid, cyc["request_key"], cyc["model_id"])
+    got_plan = client.get_plan(
+        wid, cyc["request_key"], cyc["plans"]["training_plan"]
+    )
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(Bc, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, Bc)]
+    out = got_plan(X, y, np.float32(0.05), *model_params)
+    loss, acc = float(out[0]), float(out[1])
+    assert np.isfinite(loss) and 0.0 <= acc <= 1.0
+    new_params = [np.asarray(t) for t in out[2:]]
+    diff = [p - n for p, n in zip(model_params, new_params)]
+    rep = client.report(wid, cyc["request_key"], serialize_model_params(diff))
+    assert rep.get("status") == "success", rep
+    client.close()
+
+    latest = mc.retrieve_model(name, version)
+    moved = any(not np.allclose(a, b) for a, b in zip(latest, params))
+    assert moved, "CNN aggregation did not move params"
+    mc.close()
