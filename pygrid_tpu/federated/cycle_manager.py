@@ -72,6 +72,7 @@ class CycleManager:
     ) -> None:
         self._cycles = Warehouse(S.Cycle, db)
         self._worker_cycles = Warehouse(S.WorkerCycle, db)
+        self._opt_states = Warehouse(S.ServerOptState, db)
         self.process_manager = process_manager
         self.model_manager = model_manager
         self.plan_manager = plan_manager
@@ -325,14 +326,41 @@ class CycleManager:
                         acc.add(unserialize_model_params(d))
                 avg_diff = acc.mean()
 
-            new_params = [
-                np.asarray(p) - np.asarray(d)
-                for p, d in zip(params, avg_diff)
-            ]
+            new_params, opt_state = self._server_update(
+                model.id, params, avg_diff, server_config
+            )
             self.model_manager.save(
                 model.id, serialize_model_params(new_params)
             )
+            self._save_opt_state(model.id, opt_state)
             self._finish_cycle(process, cycle, server_config)
+
+    def _server_update(
+        self, model_id: int, params: list, avg_diff: list, server_config: dict
+    ) -> tuple[list, dict | None]:
+        """Apply the configured server optimizer (FedOpt — server_opt.py) to
+        the averaged pseudo-gradient; plain FedAvg when unconfigured."""
+        from pygrid_tpu.federated.server_opt import apply_server_optimizer
+        from pygrid_tpu.serde import deserialize
+
+        opt_config = server_config.get("server_optimizer")
+        state = None
+        if opt_config:
+            rec = self._opt_states.first(model_id=model_id)
+            if rec is not None and rec.state:
+                state = deserialize(rec.state)
+        return apply_server_optimizer(params, avg_diff, opt_config, state)
+
+    def _save_opt_state(self, model_id: int, state: dict | None) -> None:
+        if state is None:
+            return
+        from pygrid_tpu.serde import serialize
+
+        blob = serialize(state)
+        if self._opt_states.contains(model_id=model_id):
+            self._opt_states.modify({"model_id": model_id}, {"state": blob})
+        else:
+            self._opt_states.register(model_id=model_id, state=blob)
 
     def _finish_cycle(
         self, process: S.FLProcess, cycle: S.Cycle, server_config: dict

@@ -10,6 +10,7 @@ ProcessManager (``processes/process_manager.py:21-137``), PlanManager
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 from pygrid_tpu.federated import schemas as S
@@ -188,8 +189,11 @@ class ModelManager:
         self._checkpoints = Warehouse(S.ModelCheckPoint, db)
         #: (model_id, precision) -> (checkpoint_id, wire blob) — per model,
         #: so concurrently-served processes don't evict each other; the
-        #: hot download path skips the sqlite megabyte row read entirely
+        #: hot download path skips the sqlite megabyte row read entirely.
+        #: Lock: downloads run on executor threads while aggregation saves
+        #: from the task thread — unsynchronized eviction would race.
         self._blob_cache: dict[tuple[int, str], tuple[int, bytes]] = {}
+        self._blob_lock = threading.Lock()
         self._latest_ckpt: dict[int, int] = {}
 
     def create(self, model_params_blob: bytes, process: S.FLProcess) -> S.Model:
@@ -236,10 +240,14 @@ class ModelManager:
         # unbounded cache keys
         precision = "bf16" if precision == "bf16" else "f32"
         key = (model_id, precision)
-        latest = self._latest_ckpt.get(model_id)
-        entry = self._blob_cache.get(key)
-        if latest is not None and entry is not None and entry[0] == latest:
-            return entry[1]
+        with self._blob_lock:
+            latest = self._latest_ckpt.get(model_id)
+            entry = self._blob_cache.get(key)
+            if latest is not None and entry is not None and entry[0] == latest:
+                # refresh recency: eviction must hit cold keys, not this one
+                self._blob_cache.pop(key)
+                self._blob_cache[key] = entry
+                return entry[1]
         ckpt = self.load(model_id=model_id)
         self._latest_ckpt[model_id] = ckpt.id
         if precision == "bf16":
@@ -262,10 +270,14 @@ class ModelManager:
     BLOB_CACHE_MAX = 16
 
     def _cache_put(self, key: tuple, entry: tuple) -> None:
-        self._blob_cache.pop(key, None)
-        self._blob_cache[key] = entry  # dict order = insertion = LRU-ish
-        while len(self._blob_cache) > self.BLOB_CACHE_MAX:
-            self._blob_cache.pop(next(iter(self._blob_cache)))
+        with self._blob_lock:
+            self._blob_cache.pop(key, None)
+            self._blob_cache[key] = entry  # dict order = recency (LRU)
+            while len(self._blob_cache) > self.BLOB_CACHE_MAX:
+                oldest = next(iter(self._blob_cache), None)
+                if oldest is None:
+                    break
+                self._blob_cache.pop(oldest, None)
 
 
 class WorkerManager:

@@ -99,3 +99,14 @@ class ProtocolRecord:
     name: str = ""
     value: bytes = b""
     fl_process_id: int = 0
+
+
+@dataclass
+class ServerOptState:
+    """FedOpt server-optimizer state (momentum / Adam moments) per model —
+    a serde blob so a restarted node resumes with its estimates intact
+    (no reference analog: the reference has no server optimizer)."""
+
+    id: int | None = None
+    model_id: int = 0
+    state: bytes = b""

@@ -1,0 +1,140 @@
+"""FedOpt server optimizers (Reddi et al.) — the node applies a stateful
+update to the averaged pseudo-gradient instead of the reference's hardcoded
+``params - avg_diff``. No reference analog (cycle_manager.py:295-298 is
+plain subtraction there)."""
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.federated import FLController, tasks
+from pygrid_tpu.federated.server_opt import apply_server_optimizer
+from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
+from pygrid_tpu.storage import Database
+from pygrid_tpu.utils.codes import CYCLE
+from pygrid_tpu.utils.exceptions import PyGridError
+
+tasks.set_sync(True)
+
+
+def _p():
+    rng = np.random.RandomState(0)
+    return [rng.randn(6, 3).astype(np.float32), rng.randn(3).astype(np.float32)]
+
+
+def _g():
+    rng = np.random.RandomState(1)
+    return [rng.randn(6, 3).astype(np.float32) * 0.1,
+            rng.randn(3).astype(np.float32) * 0.1]
+
+
+def test_none_config_is_reference_fedavg():
+    p, g = _p(), _g()
+    new, state = apply_server_optimizer(p, g, None, None)
+    assert state is None
+    for n, pi, gi in zip(new, p, g):
+        np.testing.assert_allclose(n, pi - gi, rtol=1e-6)
+
+
+def test_sgd_scales_by_lr():
+    p, g = _p(), _g()
+    new, _ = apply_server_optimizer(p, g, {"name": "sgd", "lr": 0.5}, None)
+    for n, pi, gi in zip(new, p, g):
+        np.testing.assert_allclose(n, pi - 0.5 * gi, rtol=1e-6)
+
+
+def test_momentum_accumulates():
+    p, g = _p(), _g()
+    cfg = {"name": "momentum", "lr": 1.0, "beta": 0.9}
+    new1, s1 = apply_server_optimizer(p, g, cfg, None)
+    new2, s2 = apply_server_optimizer(new1, g, cfg, s1)
+    # second step's velocity = 0.9*g + g = 1.9g
+    for n2, n1, gi in zip(new2, new1, g):
+        np.testing.assert_allclose(n2, n1 - 1.9 * gi, rtol=1e-5)
+
+
+def test_adam_matches_hand_rolled():
+    p, g = _p(), _g()
+    cfg = {"name": "adam", "lr": 0.1, "beta1": 0.9, "beta2": 0.99, "eps": 1e-3}
+    new, s = apply_server_optimizer(p, g, cfg, None)
+    for n, pi, gi in zip(new, p, g):
+        m_hat = gi          # (1-b1)g / (1-b1)
+        v_hat = gi * gi     # (1-b2)g^2 / (1-b2)
+        np.testing.assert_allclose(
+            n, pi - 0.1 * m_hat / (np.sqrt(v_hat) + 1e-3), rtol=1e-5
+        )
+    assert s["t"] == 1
+
+
+def test_unknown_name_rejected():
+    with pytest.raises(PyGridError, match="unknown server optimizer"):
+        apply_server_optimizer(_p(), _g(), {"name": "lion"}, None)
+
+
+def _host(ctl, name, server_opt):
+    import jax
+    import jax.numpy as jnp
+
+    from pygrid_tpu.plans import Plan
+
+    def step(X, y, lr, w, b):
+        def loss_fn(pr):
+            pred = X @ pr[0] + pr[1]
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)((w, b))
+        return loss, w - lr * grads[0], b - lr * grads[1]
+
+    params = [np.zeros((4, 2), np.float32), np.zeros(2, np.float32)]
+    plan = Plan(name="training_plan", fn=step)
+    plan.build(np.zeros((4, 4), np.float32), np.zeros((4, 2), np.float32),
+               np.float32(0.1), *params)
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": plan},
+        name=name, version="1.0",
+        client_config={"name": name, "version": "1.0", "batch_size": 4,
+                       "lr": 0.1, "max_updates": 1},
+        server_config={"min_workers": 1, "max_workers": 1, "min_diffs": 1,
+                       "max_diffs": 1, "num_cycles": 3,
+                       "server_optimizer": server_opt},
+    )
+    return params
+
+
+def _one_cycle(ctl, name, wid, diff):
+    w = ctl.worker_manager.create(wid)
+    w.avg_upload, w.avg_download, w.ping = 100.0, 100.0, 1.0
+    ctl.worker_manager.update(w)
+    w = ctl.worker_manager.get(id=wid)
+    resp = ctl.assign(name, "1.0", w)
+    assert resp[CYCLE.STATUS] == CYCLE.ACCEPTED
+    ctl.submit_diff(wid, resp[CYCLE.KEY], serialize_model_params(diff))
+    return resp["model_id"]
+
+
+def test_fedadam_through_controller_with_restart():
+    """Server-Adam state persists in SQL: a 'restarted' controller (fresh
+    CycleManager over the same db) continues the moment estimates."""
+    db = Database(":memory:")
+    ctl = FLController(db)
+    cfg = {"name": "adam", "lr": 0.1, "beta1": 0.9, "beta2": 0.99, "eps": 1e-3}
+    params = _host(ctl, "fedadam", cfg)
+    g = [np.full((4, 2), 0.2, np.float32), np.full(2, 0.2, np.float32)]
+
+    model_id = _one_cycle(ctl, "fedadam", "w1", g)
+    after1 = unserialize_model_params(
+        ctl.model_manager.load(model_id=model_id, alias="latest").value
+    )
+    expected1, s1 = apply_server_optimizer(params, g, cfg, None)
+    for a, b in zip(after1, expected1):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    # restart: new controller over the same db — opt state must reload
+    ctl2 = FLController(db)
+    _one_cycle(ctl2, "fedadam", "w2", g)
+    after2 = unserialize_model_params(
+        ctl2.model_manager.load(model_id=model_id, alias="latest").value
+    )
+    expected2, _ = apply_server_optimizer(expected1, g, cfg, s1)
+    for a, b in zip(after2, expected2):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
