@@ -103,11 +103,47 @@ class FLJob:
     def report(self, diff_params: list) -> dict:
         """Upload the weight diff (reference fl_events.py report:237-271).
 
-        When the hosted process sets ``client_config["diff_precision"] =
-        "bf16"`` the diff travels as bfloat16 — half the upload bytes, the
-        dtype the aggregation runs in on TPU anyway."""
+        ``client_config["diff_precision"] = "bf16"`` ships bfloat16 — half
+        the upload bytes. ``client_config["diff_compression"] = {"name":
+        "topk", "fraction": f}`` ships only the top-f fraction of entries
+        per tensor, with the dropped remainder carried into this client's
+        next report (error feedback — federated/compression.py)."""
+        import numpy as np
+
         precision = self.diff_precision or self.client_config.get("diff_precision")
-        blob = serialize_model_params(list(diff_params), bf16=precision == "bf16")
+        bf16 = precision == "bf16"
+        compression = self.client_config.get("diff_compression") or {}
+        if compression.get("name") == "topk":
+            from pygrid_tpu.federated.compression import topk_compress
+            from pygrid_tpu.serde import serialize
+
+            diffs = [np.asarray(d) for d in diff_params]
+            res_key = (self.model_name, self.model_version)
+            residual = self.client._residuals.get(res_key)
+            if residual is not None and (
+                len(residual) != len(diffs)
+                or any(
+                    np.shape(r) != np.shape(d)
+                    for r, d in zip(residual, diffs)
+                )
+            ):
+                residual = None  # model changed under the same name: reset
+            payload, new_residual = topk_compress(
+                diffs,
+                float(compression.get("fraction", 0.1)),
+                residual=residual,
+            )
+            blob = serialize(payload, bf16_floats=bf16)
+            response = self.client.report(
+                self.worker_id, self.request_key, blob
+            )
+            if not response.get("error"):
+                # error feedback's invariant — everything not yet applied
+                # server-side lives in the residual — only holds if the
+                # residual commits AFTER the report landed
+                self.client._residuals[res_key] = new_residual
+            return response
+        blob = serialize_model_params(list(diff_params), bf16=bf16)
         return self.client.report(self.worker_id, self.request_key, blob)
 
 
@@ -135,6 +171,9 @@ class FLClient:
         # plans are immutable per id once hosted (PlanManager stores the
         # variants at host time), so refetching across cycles is pure waste
         self._plan_cache: dict[tuple[int, str], Any] = {}
+        # top-k error-feedback residuals per (model, version), carried
+        # across cycles
+        self._residuals: dict[tuple, list] = {}
 
     def new_job(self, model_name: str, model_version: str | None = None) -> FLJob:
         return FLJob(self, model_name, model_version)

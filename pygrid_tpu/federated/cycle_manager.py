@@ -30,6 +30,7 @@ import numpy as np
 
 from pygrid_tpu.federated import schemas as S
 from pygrid_tpu.federated import tasks
+from pygrid_tpu.federated.compression import decode_diff
 from pygrid_tpu.federated.managers import ModelManager, PlanManager, ProcessManager
 from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
 from pygrid_tpu.storage.warehouse import Database, Warehouse
@@ -202,6 +203,14 @@ class CycleManager:
             # an empty blob must not count toward readiness — completed rows
             # are what complete_cycle counts, so every one must carry a diff
             raise E.PyGridError("empty diff")
+        # decode BEFORE storing: a malformed blob must bounce back to the
+        # reporting worker as an error, never become a stored poison row
+        # that counts toward readiness and re-raises on every completion
+        # attempt (decode_diff validates worker-supplied sparse envelopes)
+        try:
+            decoded = decode_diff(diff)
+        except Exception as err:
+            raise E.PyGridError(f"undecodable diff: {err}") from err
         self._worker_cycles.modify(
             {"id": wc.id},
             {
@@ -214,8 +223,8 @@ class CycleManager:
             # fold into the running sum now — aggregation work rides each
             # report instead of spiking at cycle completion (the blob is
             # still stored above: parity surface + restart recovery).
-            # Decode outside the lock: only the cheap fold serializes.
-            decoded = unserialize_model_params(diff)
+            # Decode happened outside the lock: only the cheap fold
+            # serializes.
             with self._accum_lock:
                 acc = self._accum.setdefault(cycle.id, _DiffAccumulator())
                 acc.add(decoded)
@@ -306,8 +315,7 @@ class CycleManager:
             )
             if avg_plan_rec is not None and avg_plan_rec.value_xla:
                 diff_params = [
-                    unserialize_model_params(d)
-                    for d in self._received_diffs(cycle.id)
+                    decode_diff(d) for d in self._received_diffs(cycle.id)
                 ]
                 avg_diff = self._run_avg_plan(
                     avg_plan_rec, diff_params, server_config
@@ -323,7 +331,7 @@ class CycleManager:
                 if acc is None or acc.count != len(received):
                     acc = _DiffAccumulator()
                     for d in received:
-                        acc.add(unserialize_model_params(d))
+                        acc.add(decode_diff(d))
                 avg_diff = acc.mean()
 
             new_params, opt_state = self._server_update(

@@ -1,0 +1,199 @@
+"""Top-k diff compression with error feedback (federated/compression.py) —
+wire-format round-trip, residual semantics, and convergence under
+compression. No reference analog (the reference always ships dense diffs)."""
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.federated.compression import (
+    MIN_SPARSE_ELEMENTS,
+    decode_diff,
+    is_sparse_diff,
+    topk_compress,
+    topk_decompress,
+)
+from pygrid_tpu.serde import serialize
+from pygrid_tpu.utils.exceptions import PyGridError
+
+
+def _diffs():
+    rng = np.random.RandomState(0)
+    return [
+        rng.randn(64, 64).astype(np.float32),     # sparse candidate (4096)
+        rng.randn(10).astype(np.float32),          # stays dense
+    ]
+
+
+def test_roundtrip_keeps_topk_exactly():
+    diffs = _diffs()
+    payload, residual = topk_compress(diffs, fraction=0.1)
+    assert is_sparse_diff(payload)
+    dense = topk_decompress(payload)
+    # kept entries match, dropped entries are zero, kept+residual == original
+    k = int(round(diffs[0].size * 0.1))
+    assert np.count_nonzero(dense[0]) == k
+    np.testing.assert_allclose(dense[0] + residual[0], diffs[0], rtol=1e-6)
+    # the small tensor shipped dense with zero residual
+    np.testing.assert_array_equal(dense[1], diffs[1])
+    assert not residual[1].any()
+
+
+def test_topk_selects_largest_magnitude():
+    d = np.zeros((40, 40), np.float32)
+    d[0, 0], d[1, 1], d[2, 2] = 5.0, -7.0, 0.001
+    payload, _ = topk_compress([d], fraction=2 / d.size)
+    dense = topk_decompress(payload)[0]
+    assert dense[1, 1] == -7.0 and dense[0, 0] == 5.0
+    assert dense[2, 2] == 0.0
+
+
+def test_error_feedback_accumulates_dropped_mass():
+    """An entry too small to ever win top-k alone must eventually transmit
+    through the residual."""
+    d = np.zeros((64, 64), np.float32)
+    d[0, 0] = 1.0      # always wins
+    d[5, 5] = 0.3      # loses to 1.0 at k=1, but residual grows
+    residual = None
+    transmitted = np.zeros_like(d)
+    for _ in range(5):
+        payload, residual = topk_compress([d], 1 / d.size, residual=[residual[0]] if residual else None)
+        transmitted += topk_decompress(payload)[0]
+    # after 5 rounds the 0.3-coordinate's accumulated residual (1.5) beat
+    # the 1.0 entry at least once
+    assert transmitted[5, 5] > 0.0
+
+
+def test_wire_size_shrinks():
+    diffs = [np.random.RandomState(1).randn(392, 784).astype(np.float32)]
+    dense_size = len(serialize(diffs))
+    payload, _ = topk_compress(diffs, fraction=0.05)
+    sparse_size = len(serialize(payload))
+    assert sparse_size < 0.12 * dense_size  # 5% values + int32 indices
+
+
+def test_decode_diff_handles_both_formats():
+    from pygrid_tpu.plans.state import serialize_model_params
+
+    diffs = _diffs()
+    dense_blob = serialize_model_params(diffs)
+    for a, b in zip(decode_diff(dense_blob), diffs):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    payload, _ = topk_compress(diffs, 0.5)
+    sparse_blob = serialize(payload)
+    decoded = decode_diff(sparse_blob)
+    assert np.count_nonzero(decoded[0]) == int(round(diffs[0].size * 0.5))
+
+
+def test_bad_fraction_rejected():
+    with pytest.raises(PyGridError, match="fraction"):
+        topk_compress(_diffs(), fraction=0.0)
+    with pytest.raises(PyGridError, match="fraction"):
+        topk_compress(_diffs(), fraction=1.5)
+
+
+def test_compressed_fedavg_converges():
+    """Linear regression via simulated FedAvg with 10% top-k + error
+    feedback: loss must still drop to near the dense trajectory."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(128, 64).astype(np.float32)
+    true_w = rng.randn(64, 1).astype(np.float32)
+    y = X @ true_w
+
+    def run(compressed: bool) -> float:
+        w = np.zeros((64, 1), np.float32)
+        residuals = [None, None]
+        for _ in range(200):
+            diffs = []
+            for c in range(2):
+                Xc, yc = X[c::2], y[c::2]
+                grad = 2 * Xc.T @ (Xc @ w - yc) / len(Xc)
+                diff = 0.01 * grad  # lr * grad = the reported diff
+                if compressed:
+                    payload, res = topk_compress(
+                        [diff], 0.1,
+                        residual=residuals[c],
+                    )
+                    residuals[c] = res
+                    diff = topk_decompress(payload)[0]
+                diffs.append(diff)
+            w = w - np.mean(diffs, axis=0)
+        return float(np.mean((X @ w - y) ** 2))
+
+    dense_loss = run(False)
+    sparse_loss = run(True)
+    start_loss = float(np.mean(y**2))
+    assert sparse_loss < 0.05 * start_loss
+    assert sparse_loss < 10 * max(dense_loss, 1e-6)
+
+
+def test_malformed_sparse_payloads_rejected():
+    """Worker-supplied fields are validated: absurd shapes, out-of-range
+    indices, length mismatches all raise typed errors instead of allocating
+    or wedging."""
+    import pytest as _pytest
+
+    huge = {"__pygrid_sparse_diff__": True, "tensors": [
+        {"shape": [10**12], "indices": np.array([0]), "values": np.array([1.0], np.float32)}
+    ]}
+    with _pytest.raises(PyGridError, match="out of bounds"):
+        topk_decompress(huge)
+    oob = {"__pygrid_sparse_diff__": True, "tensors": [
+        {"shape": [4, 4], "indices": np.array([99]), "values": np.array([1.0], np.float32)}
+    ]}
+    with _pytest.raises(PyGridError, match="out of range"):
+        topk_decompress(oob)
+    mismatch = {"__pygrid_sparse_diff__": True, "tensors": [
+        {"shape": [4, 4], "indices": np.array([1, 2]), "values": np.array([1.0], np.float32)}
+    ]}
+    with _pytest.raises(PyGridError, match="mismatch"):
+        topk_decompress(mismatch)
+
+
+def test_poison_diff_does_not_count_toward_readiness():
+    """A malformed diff bounces as an error BEFORE the worker_cycle row is
+    marked complete — it must not poison cycle readiness (the row would
+    re-raise on every completion attempt forever)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pygrid_tpu.federated import FLController, tasks
+    from pygrid_tpu.plans import Plan
+    from pygrid_tpu.plans.state import serialize_model_params
+    from pygrid_tpu.storage import Database
+    from pygrid_tpu.utils.codes import CYCLE
+
+    tasks.set_sync(True)
+
+    def step(X, y, lr, w):
+        loss = jnp.mean((X @ w - y) ** 2)
+        return loss, w - lr * jax.grad(lambda w_: jnp.mean((X @ w_ - y) ** 2))(w)
+
+    params = [np.zeros((4, 2), np.float32)]
+    plan = Plan(name="training_plan", fn=step)
+    plan.build(np.zeros((4, 4), np.float32), np.zeros((4, 2), np.float32),
+               np.float32(0.1), *params)
+    db = Database(":memory:")
+    ctl = FLController(db)
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": plan},
+        name="poison", version="1.0",
+        client_config={"name": "poison", "version": "1.0"},
+        server_config={"min_workers": 1, "max_workers": 2, "min_diffs": 1,
+                       "max_diffs": 1, "num_cycles": 1},
+    )
+    w = ctl.worker_manager.create("evil")
+    w.avg_upload = w.avg_download = 100.0; w.ping = 1.0
+    ctl.worker_manager.update(w)
+    resp = ctl.assign("poison", "1.0", ctl.worker_manager.get(id="evil"))
+    assert resp[CYCLE.STATUS] == CYCLE.ACCEPTED
+
+    poison = serialize({"__pygrid_sparse_diff__": True, "tensors": [
+        {"shape": [10**12], "indices": np.array([0]),
+         "values": np.array([1.0], np.float32)}
+    ]})
+    with pytest.raises(PyGridError, match="undecodable diff"):
+        ctl.submit_diff("evil", resp[CYCLE.KEY], poison)
+    # the row did not count: cycle still open, zero completed rows
+    assert ctl.cycle_manager.count_worker_cycles(is_completed=True) == 0
+    assert ctl.cycle_manager.count_cycles(is_completed=False) == 1
