@@ -54,6 +54,24 @@ class FLController:
         client_protocols: dict[str, bytes] | None = None,
     ) -> S.FLProcess:
         """(reference :23-67) process + assets + configs + model + 1st cycle."""
+        dp = server_config.get("differential_privacy")
+        if dp is not None:
+            # fail at host time, not on every worker's report
+            clip = dp.get("clip_norm")
+            if not isinstance(clip, (int, float)) or clip <= 0:
+                raise E.PyGridError(
+                    "differential_privacy requires a positive clip_norm"
+                )
+            if float(dp.get("noise_multiplier", 0.0)) < 0:
+                raise E.PyGridError("noise_multiplier must be >= 0")
+            if server_averaging_plan is not None:
+                # the σ = z·C/K calibration assumes the unweighted mean; an
+                # arbitrary hosted plan has unknown sensitivity
+                raise E.PyGridError(
+                    "differential_privacy cannot be combined with a custom "
+                    "averaging plan (noise is calibrated to the mean's "
+                    "C/K sensitivity)"
+                )
         process = self.process_manager.create(
             name=name,
             version=version,

@@ -79,6 +79,8 @@ class CycleManager:
         self.plan_manager = plan_manager
         self._accum: dict[int, _DiffAccumulator] = {}
         self._accum_lock = threading.Lock()
+        self._dp_cache: dict[int, dict | None] = {}
+        self._shape_cache: dict[int, list[tuple]] = {}
         self._deadline_timers: dict[int, threading.Timer] = {}
         # avg-plan presence is immutable after hosting — cached so the hot
         # report path doesn't re-query the plan table per diff
@@ -211,6 +213,15 @@ class CycleManager:
             decoded = decode_diff(diff)
         except Exception as err:
             raise E.PyGridError(f"undecodable diff: {err}") from err
+        # a decodable diff with the wrong arity/shapes is just as poisonous
+        # as a malformed one: zip() in the accumulator would silently
+        # truncate, broadcasting would silently corrupt — reject exactly
+        expected = self._model_shapes(cycle.fl_process_id)
+        got = [tuple(np.shape(t)) for t in decoded]
+        if got != expected:
+            raise E.PyGridError(
+                f"diff shapes {got} do not match model shapes {expected}"
+            )
         self._worker_cycles.modify(
             {"id": wc.id},
             {
@@ -225,6 +236,15 @@ class CycleManager:
             # still stored above: parity surface + restart recovery).
             # Decode happened outside the lock: only the cheap fold
             # serializes.
+            dp = self._dp_config(cycle.fl_process_id)
+            if dp:
+                # clip at ingest: the accumulator only ever holds bounded
+                # per-client contributions (DP-FedAvg, federated/privacy.py;
+                # DP + custom avg plan is rejected at host time, so the
+                # fallback path is the only aggregation door under DP)
+                from pygrid_tpu.federated.privacy import clip_diff
+
+                decoded = clip_diff(decoded, float(dp["clip_norm"]))
             with self._accum_lock:
                 acc = self._accum.setdefault(cycle.id, _DiffAccumulator())
                 acc.add(decoded)
@@ -235,6 +255,33 @@ class CycleManager:
                 with self._accum_lock:
                     self._accum.pop(cycle.id, None)
         tasks.run_task_once(f"complete_cycle_{cycle.id}", self.complete_cycle, cycle.id)
+
+    def _model_shapes(self, fl_process_id: int) -> list[tuple]:
+        """Expected diff tensor shapes — the model's parameter shapes, fixed
+        at hosting (cached; the report path must not re-read the megabyte
+        checkpoint per diff)."""
+        cached = self._shape_cache.get(fl_process_id)
+        if cached is None:
+            model = self.model_manager.get(fl_process_id=fl_process_id)
+            ckpt = self.model_manager.load(model_id=model.id, alias="latest")
+            cached = [
+                tuple(np.shape(t))
+                for t in unserialize_model_params(ckpt.value)
+            ]
+            self._shape_cache[fl_process_id] = cached
+        return cached
+
+    def _dp_config(self, fl_process_id: int) -> dict | None:
+        """The process's differential_privacy config (cached — immutable
+        after hosting, and the report path must not re-query per diff)."""
+        cached = self._dp_cache.get(fl_process_id, _UNSET)
+        if cached is _UNSET:
+            server_config = self.process_manager.get_configs(
+                fl_process_id=fl_process_id, is_server_config=True
+            )
+            cached = server_config.get("differential_privacy") or None
+            self._dp_cache[fl_process_id] = cached
+        return cached
 
     def _uses_fallback_mean(self, fl_process_id: int) -> bool:
         """True when no hosted averaging plan will run (the hardcoded-FedAvg
@@ -313,9 +360,25 @@ class CycleManager:
             avg_plan_rec = self.plan_manager._plans.first(
                 fl_process_id=process.id, is_avg_plan=True
             )
+            dp = server_config.get("differential_privacy") or None
+            n_diffs = self._worker_cycles.count(
+                cycle_id=cycle.id, is_completed=True
+            )
+
+            def _decode(d: bytes) -> list:
+                # stored blobs are the raw uploads; under DP every decoded
+                # contribution re-clips (the accumulator path clipped at
+                # ingest — both doors must bound identically)
+                decoded = decode_diff(d)
+                if dp:
+                    from pygrid_tpu.federated.privacy import clip_diff
+
+                    decoded = clip_diff(decoded, float(dp["clip_norm"]))
+                return decoded
+
             if avg_plan_rec is not None and avg_plan_rec.value_xla:
                 diff_params = [
-                    decode_diff(d) for d in self._received_diffs(cycle.id)
+                    _decode(d) for d in self._received_diffs(cycle.id)
                 ]
                 avg_diff = self._run_avg_plan(
                     avg_plan_rec, diff_params, server_config
@@ -331,8 +394,18 @@ class CycleManager:
                 if acc is None or acc.count != len(received):
                     acc = _DiffAccumulator()
                     for d in received:
-                        acc.add(decode_diff(d))
+                        acc.add(_decode(d))
                 avg_diff = acc.mean()
+
+            if dp:
+                from pygrid_tpu.federated.privacy import add_gaussian_noise
+
+                avg_diff = add_gaussian_noise(
+                    avg_diff,
+                    float(dp["clip_norm"]),
+                    float(dp.get("noise_multiplier", 0.0)),
+                    n_diffs,
+                )
 
             new_params, opt_state = self._server_update(
                 model.id, params, avg_diff, server_config
@@ -430,3 +503,8 @@ class CycleManager:
             flat.extend(np.asarray(t) for t in diff)
         out = plan(*flat)
         return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+#: sentinel distinguishing "not cached" from a cached None (processes
+#: without a differential_privacy config)
+_UNSET = object()

@@ -1,0 +1,188 @@
+"""DP-FedAvg (federated/privacy.py): per-client clipping at ingest +
+calibrated Gaussian noise on the mean. No reference analog (raw diffs
+there)."""
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.federated import FLController, tasks
+from pygrid_tpu.federated.privacy import (
+    add_gaussian_noise,
+    clip_diff,
+    global_l2_norm,
+)
+from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
+from pygrid_tpu.storage import Database
+from pygrid_tpu.utils.codes import CYCLE
+from pygrid_tpu.utils.exceptions import PyGridError
+
+tasks.set_sync(True)
+
+
+def test_clip_preserves_small_diffs_exactly():
+    d = [np.full((4, 4), 0.01, np.float32), np.full(4, 0.01, np.float32)]
+    out = clip_diff(d, clip_norm=1.0)
+    for a, b in zip(out, d):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_clip_bounds_large_diffs():
+    d = [np.full((100,), 5.0, np.float32)]
+    out = clip_diff(d, clip_norm=1.0)
+    assert abs(global_l2_norm(out) - 1.0) < 1e-5
+    # direction preserved
+    assert np.allclose(out[0] / np.linalg.norm(out[0]),
+                       d[0] / np.linalg.norm(d[0]), atol=1e-6)
+
+
+def test_clip_rejects_bad_norm():
+    with pytest.raises(PyGridError):
+        clip_diff([np.ones(3, np.float32)], clip_norm=0.0)
+
+
+def test_noise_statistics():
+    """σ = z·C/K per coordinate, mean ~0 (law-of-large-numbers check)."""
+    zeros = [np.zeros(200_000, np.float32)]
+    z, C, K = 1.5, 2.0, 10
+    noised = add_gaussian_noise(zeros, C, z, K)[0]
+    sigma = z * C / K
+    assert abs(float(noised.mean())) < 5 * sigma / np.sqrt(noised.size)
+    assert abs(float(noised.std()) - sigma) < 0.02 * sigma
+    # zero multiplier: exact passthrough
+    clean = add_gaussian_noise(zeros, C, 0.0, K)[0]
+    np.testing.assert_array_equal(clean, zeros[0])
+
+
+def test_noise_is_not_replayable():
+    zeros = [np.zeros(64, np.float32)]
+    a = add_gaussian_noise(zeros, 1.0, 1.0, 1)[0]
+    b = add_gaussian_noise(zeros, 1.0, 1.0, 1)[0]
+    assert not np.array_equal(a, b)
+
+
+def _host_dp(ctl, dp):
+    import jax
+    import jax.numpy as jnp
+
+    from pygrid_tpu.plans import Plan
+
+    def step(X, y, lr, w):
+        def loss_fn(w_):
+            return jnp.mean((X @ w_ - y) ** 2)
+        return loss_fn(w), w - lr * jax.grad(loss_fn)(w)
+
+    params = [np.zeros((4, 2), np.float32)]
+    plan = Plan(name="training_plan", fn=step)
+    plan.build(np.zeros((4, 4), np.float32), np.zeros((4, 2), np.float32),
+               np.float32(0.1), *params)
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": plan},
+        name="dp", version="1.0",
+        client_config={"name": "dp", "version": "1.0"},
+        server_config={"min_workers": 2, "max_workers": 2, "min_diffs": 2,
+                       "max_diffs": 2, "num_cycles": 1,
+                       "differential_privacy": dp},
+    )
+    return params
+
+
+def _report(ctl, wid, diff):
+    w = ctl.worker_manager.create(wid)
+    w.avg_upload = w.avg_download = 100.0; w.ping = 1.0
+    ctl.worker_manager.update(w)
+    resp = ctl.assign("dp", "1.0", ctl.worker_manager.get(id=wid))
+    assert resp[CYCLE.STATUS] == CYCLE.ACCEPTED
+    ctl.submit_diff(wid, resp[CYCLE.KEY], serialize_model_params(diff))
+    return resp["model_id"]
+
+
+def test_dp_clipping_bounds_adversarial_worker():
+    """One worker uploads a 1000x-magnitude diff: under clip_norm its
+    influence on the aggregate is bounded to C/K, not 1000/K."""
+    db = Database(":memory:")
+    ctl = FLController(db)
+    params = _host_dp(ctl, {"clip_norm": 0.1, "noise_multiplier": 0.0})
+    honest = [np.full((4, 2), 0.01, np.float32)]
+    evil = [np.full((4, 2), 1000.0, np.float32)]
+    _report(ctl, "honest", honest)
+    model_id = _report(ctl, "evil", evil)
+    latest = unserialize_model_params(
+        ctl.model_manager.load(model_id=model_id, alias="latest").value
+    )
+    # without clipping the update would be ~500 per coord; with C=0.1 the
+    # evil contribution is <= 0.1/2 total L2
+    assert global_l2_norm([np.asarray(latest[0])]) < 0.2
+
+
+def test_dp_restart_rebuild_reclips():
+    """The rebuild-from-blobs path (lost accumulator) clips identically to
+    the ingest path — stored blobs are raw uploads."""
+    db = Database(":memory:")
+    ctl = FLController(db)
+    _host_dp(ctl, {"clip_norm": 0.1, "noise_multiplier": 0.0})
+    _report(ctl, "w1", [np.full((4, 2), 1000.0, np.float32)])
+    # lose the accumulator mid-cycle, then the final diff arrives
+    ctl.cycle_manager._accum.clear()
+    model_id = _report(ctl, "w2", [np.full((4, 2), 1000.0, np.float32)])
+    latest = unserialize_model_params(
+        ctl.model_manager.load(model_id=model_id, alias="latest").value
+    )
+    assert global_l2_norm([np.asarray(latest[0])]) < 0.2
+
+
+def test_dp_config_validated_at_host_time():
+    db = Database(":memory:")
+    ctl = FLController(db)
+    with pytest.raises(PyGridError, match="clip_norm"):
+        _host_dp(ctl, {"noise_multiplier": 1.0})
+
+
+def test_wrong_shape_diff_rejected_at_ingest():
+    """A decodable diff with mismatched shapes bounces before storage —
+    zip truncation / broadcasting must never corrupt the aggregate."""
+    db = Database(":memory:")
+    ctl = FLController(db)
+    _host_dp(ctl, {"clip_norm": 1.0, "noise_multiplier": 0.0})
+    w = ctl.worker_manager.create("shapeshifter")
+    w.avg_upload = w.avg_download = 100.0; w.ping = 1.0
+    ctl.worker_manager.update(w)
+    resp = ctl.assign("dp", "1.0", ctl.worker_manager.get(id="shapeshifter"))
+    bad = [np.zeros((8, 8), np.float32)]  # model is [(4, 2)]
+    with pytest.raises(PyGridError, match="shapes"):
+        ctl.submit_diff("shapeshifter", resp[CYCLE.KEY], serialize_model_params(bad))
+    assert ctl.cycle_manager.count_worker_cycles(is_completed=True) == 0
+
+
+def test_dp_with_custom_avg_plan_rejected():
+    import jax
+    import jax.numpy as jnp
+
+    from pygrid_tpu.plans import Plan
+
+    def step(X, y, lr, w):
+        def loss_fn(w_):
+            return jnp.mean((X @ w_ - y) ** 2)
+        return loss_fn(w), w - lr * jax.grad(loss_fn)(w)
+
+    def avg(a, d, i):
+        return a + (d - a) / i
+
+    params = [np.zeros((4, 2), np.float32)]
+    plan = Plan(name="training_plan", fn=step)
+    plan.build(np.zeros((4, 4), np.float32), np.zeros((4, 2), np.float32),
+               np.float32(0.1), *params)
+    avg_plan = Plan(name="avg_plan", fn=avg)
+    avg_plan.build(params[0], params[0], np.float32(1.0))
+    db = Database(":memory:")
+    ctl = FLController(db)
+    with pytest.raises(PyGridError, match="averaging plan"):
+        ctl.create_process(
+            model_blob=serialize_model_params(params),
+            client_plans={"training_plan": plan},
+            name="dp-avg", version="1.0",
+            client_config={"name": "dp-avg", "version": "1.0"},
+            server_config={"min_diffs": 1, "max_diffs": 1, "num_cycles": 1,
+                           "differential_privacy": {"clip_norm": 1.0}},
+            server_averaging_plan=avg_plan,
+        )
