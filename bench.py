@@ -467,22 +467,59 @@ def _arm_watchdog() -> threading.Timer:
     return timer
 
 
+def _tpu_reachable(probe_timeout: float = 120.0) -> bool:
+    """Probe the accelerator in a SUBPROCESS: a dark tunnel hangs the first
+    device call forever (observed in-session), and a hung probe must not
+    take the bench with it."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "print(float((jnp.ones((128,128))@jnp.ones((128,128)))[0,0]))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=probe_timeout,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     watchdog = _arm_watchdog()
-    tpu_rps, mfu, tpu_rps_per_client = bench_tpu()
+    tpu_ok = _tpu_reachable()
+    if not tpu_ok:
+        # record what CAN be measured (protocol plane + CPU baseline on the
+        # host platform) with the outage marked — a partial honest record
+        # beats an empty one
+        print("TPU unreachable — protocol-only bench", file=sys.stderr)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        tpu_rps = mfu = tpu_rps_per_client = None
+    else:
+        tpu_rps, mfu, tpu_rps_per_client = bench_tpu()
     proto = bench_protocol("json")
     proto.update(bench_protocol("binary"))
-    proto.update(bench_smpc())
+    if tpu_ok:
+        proto.update(bench_smpc())
     cpu_rps = bench_cpu_torch_baseline()
     result = {
         "metric": "fedavg_rounds_per_sec_1k_clients",
-        "value": round(tpu_rps, 3),
+        "value": round(tpu_rps, 3) if tpu_ok else None,
         "unit": "rounds/sec (1024 simulated MNIST-MLP clients, batch 64)",
-        "vs_baseline": round(tpu_rps / cpu_rps, 1),
-        "mfu_pct": round(mfu * 100, 1),
-        "fedavg_rounds_per_sec_per_client_path": round(tpu_rps_per_client, 3),
+        "vs_baseline": round(tpu_rps / cpu_rps, 1) if tpu_ok else None,
+        "mfu_pct": round(mfu * 100, 1) if tpu_ok else None,
+        "fedavg_rounds_per_sec_per_client_path": (
+            round(tpu_rps_per_client, 3) if tpu_ok else None
+        ),
         **proto,
     }
+    if not tpu_ok:
+        result["tpu_unreachable"] = True
     watchdog.cancel()
     print(json.dumps(result))
 

@@ -57,6 +57,11 @@ class FLController:
         dp = server_config.get("differential_privacy")
         if dp is not None:
             # fail at host time, not on every worker's report
+            if not isinstance(dp, dict):
+                raise E.PyGridError(
+                    "differential_privacy must be a dict "
+                    "{clip_norm, noise_multiplier}"
+                )
             clip = dp.get("clip_norm")
             if not isinstance(clip, (int, float)) or clip <= 0:
                 raise E.PyGridError(

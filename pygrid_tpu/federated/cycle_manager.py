@@ -280,6 +280,10 @@ class CycleManager:
                 fl_process_id=fl_process_id, is_server_config=True
             )
             cached = server_config.get("differential_privacy") or None
+            if cached is not None and not isinstance(cached, dict):
+                # hosting validates this; a hand-edited DB row must still
+                # fail typed, not with AttributeError on the report path
+                raise E.PyGridError("differential_privacy must be a dict")
             self._dp_cache[fl_process_id] = cached
         return cached
 
@@ -360,10 +364,7 @@ class CycleManager:
             avg_plan_rec = self.plan_manager._plans.first(
                 fl_process_id=process.id, is_avg_plan=True
             )
-            dp = server_config.get("differential_privacy") or None
-            n_diffs = self._worker_cycles.count(
-                cycle_id=cycle.id, is_completed=True
-            )
+            dp = self._dp_config(process.id)
 
             def _decode(d: bytes) -> list:
                 # stored blobs are the raw uploads; under DP every decoded
@@ -376,10 +377,12 @@ class CycleManager:
                     decoded = clip_diff(decoded, float(dp["clip_norm"]))
                 return decoded
 
+            n_diffs = 0
             if avg_plan_rec is not None and avg_plan_rec.value_xla:
                 diff_params = [
                     _decode(d) for d in self._received_diffs(cycle.id)
                 ]
+                n_diffs = len(diff_params)
                 avg_diff = self._run_avg_plan(
                     avg_plan_rec, diff_params, server_config
                 )
@@ -395,6 +398,8 @@ class CycleManager:
                     acc = _DiffAccumulator()
                     for d in received:
                         acc.add(_decode(d))
+                n_diffs = acc.count  # the mean's actual divisor — a late
+                # racing report must scale the noise it is averaged under
                 avg_diff = acc.mean()
 
             if dp:
