@@ -475,7 +475,8 @@ def _tpu_reachable(probe_timeout: float = 120.0) -> bool:
 
     code = (
         "import jax, jax.numpy as jnp;"
-        "print(float((jnp.ones((128,128))@jnp.ones((128,128)))[0,0]))"
+        "print(float((jnp.ones((128,128))@jnp.ones((128,128)))[0,0]));"
+        "print('DEVICE:', jax.devices()[0].platform, jax.devices()[0])"
     )
     try:
         proc = subprocess.run(
@@ -483,7 +484,20 @@ def _tpu_reachable(probe_timeout: float = 120.0) -> bool:
             capture_output=True,
             timeout=probe_timeout,
         )
-        return proc.returncode == 0
+        if proc.returncode != 0:
+            return False
+        # the device must actually BE an accelerator ('tpu', or 'axon'
+        # tunneling a 'TPU v5 lite' chip) — a silent CPU fallback must not
+        # record TPU-labeled numbers against the 197-TFLOP peak
+        device_line = next(
+            (
+                ln
+                for ln in proc.stdout.decode().splitlines()
+                if ln.startswith("DEVICE:")
+            ),
+            "",
+        )
+        return "tpu" in device_line.lower()
     except subprocess.TimeoutExpired:
         return False
 
@@ -516,6 +530,7 @@ def main() -> None:
         "fedavg_rounds_per_sec_per_client_path": (
             round(tpu_rps_per_client, 3) if tpu_ok else None
         ),
+        "cpu_baseline_rounds_per_sec": round(cpu_rps, 4),
         **proto,
     }
     if not tpu_ok:

@@ -279,11 +279,13 @@ class CycleManager:
             server_config = self.process_manager.get_configs(
                 fl_process_id=fl_process_id, is_server_config=True
             )
-            cached = server_config.get("differential_privacy") or None
-            if cached is not None and not isinstance(cached, dict):
+            raw = server_config.get("differential_privacy")
+            if raw is not None and not isinstance(raw, dict):
                 # hosting validates this; a hand-edited DB row must still
-                # fail typed, not with AttributeError on the report path
+                # fail typed — BEFORE any falsy coercion, or [] / 0 / ""
+                # would silently disable DP instead of erroring
                 raise E.PyGridError("differential_privacy must be a dict")
+            cached = raw or None  # {} means unset
             self._dp_cache[fl_process_id] = cached
         return cached
 

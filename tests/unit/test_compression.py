@@ -197,3 +197,50 @@ def test_poison_diff_does_not_count_toward_readiness():
     # the row did not count: cycle still open, zero completed rows
     assert ctl.cycle_manager.count_worker_cycles(is_completed=True) == 0
     assert ctl.cycle_manager.count_cycles(is_completed=False) == 1
+
+
+# --- property-based: the invariants hold for arbitrary shapes/fractions ----
+
+from hypothesis import given, settings, strategies as st
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    rows=st.integers(1, 80),
+    cols=st.integers(1, 80),
+    fraction=st.floats(0.01, 1.0),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_property_kept_plus_residual_is_identity(rows, cols, fraction, seed):
+    """For any shape and fraction: decompress(payload) + residual == diff,
+    and the transmitted entry count matches the k rule."""
+    rng = np.random.RandomState(seed)
+    d = rng.randn(rows, cols).astype(np.float32)
+    payload, residual = topk_compress([d], fraction)
+    dense = topk_decompress(payload)[0]
+    np.testing.assert_allclose(dense + residual[0], d, rtol=1e-6, atol=1e-7)
+    if d.size > MIN_SPARSE_ELEMENTS:
+        assert np.count_nonzero(np.abs(dense) > 0) <= max(
+            1, int(round(d.size * fraction))
+        )
+    else:
+        np.testing.assert_array_equal(dense, d)
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    rows=st.integers(33, 100),
+    fraction=st.floats(0.01, 0.5),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_property_serde_roundtrip(rows, fraction, seed):
+    """Sparse envelopes survive the wire (serde) bit-exactly."""
+    from pygrid_tpu.serde import deserialize
+
+    rng = np.random.RandomState(seed)
+    d = rng.randn(rows, 40).astype(np.float32)
+    payload, _ = topk_compress([d], fraction)
+    again = deserialize(serialize(payload))
+    np.testing.assert_array_equal(
+        topk_decompress(again)[0], topk_decompress(payload)[0]
+    )

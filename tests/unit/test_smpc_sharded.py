@@ -142,3 +142,31 @@ def test_sharded_beaver_single_device_mesh():
     z_sh = sharded_beaver(mesh1, jax.random.fold_in(key, 2), x_sh, y_sh)
     z = R.from_ring(make_sharded_open(mesh1)(z_sh))
     np.testing.assert_array_equal(z, np.einsum("bij,bjk->bik", x, y))
+
+
+# --- property-based: ring_psum is the exact host sum for any inputs --------
+
+from hypothesis import given, settings, strategies as st
+
+
+@settings(max_examples=30, deadline=None)
+@given(seed=st.integers(0, 2**31 - 1))
+def test_property_ring_psum_matches_host_sum(open_fn, mesh, seed):
+    """Random 8-party share sets (full uint64 range, carry-heavy): the limb
+    psum equals numpy's wrapping uint64 sum, always. Fixed shape so all 30
+    examples hit one compiled program."""
+    rng = np.random.default_rng(seed)
+    vals = rng.integers(0, 2**64, size=(8, 16), dtype=np.uint64)
+    placed = jax.tree.map(
+        lambda a: jax.device_put(a, party_sharding(mesh)), R.to_ring(vals)
+    )
+    total = open_fn(placed)
+    expected = np.zeros(16, dtype=np.uint64)
+    for p in range(8):
+        expected += vals[p]
+    np.testing.assert_array_equal(R.from_ring(total), expected)
+
+
+@pytest.fixture(scope="module")
+def open_fn(mesh):
+    return make_sharded_open(mesh)
