@@ -49,9 +49,10 @@ class FLJob:
         self.plans: dict[str, Any] = {}
         self.client_config: dict = {}
         self.timeout: int | None = None  # retry window on reject
-        #: worker-side override; otherwise the hosted process's
-        #: client_config["diff_precision"] decides
+        #: worker-side overrides; otherwise the hosted process's
+        #: client_config ("diff_precision" / "diff_compression") decides
         self.diff_precision: str | None = None
+        self.diff_compression: dict | None = None
 
     def add_listener(self, event: str, callback: Callable) -> None:
         self._listeners[event].append(callback)
@@ -112,7 +113,11 @@ class FLJob:
 
         precision = self.diff_precision or self.client_config.get("diff_precision")
         bf16 = precision == "bf16"
-        compression = self.client_config.get("diff_compression") or {}
+        compression = (
+            self.diff_compression
+            or self.client_config.get("diff_compression")
+            or {}
+        )
         if compression.get("name") == "topk":
             from pygrid_tpu.federated.compression import topk_compress
             from pygrid_tpu.serde import serialize

@@ -47,6 +47,7 @@ def run_worker(
     max_retry_wait: float = 30.0,
     wire: str = "json",
     diff_precision: str | None = None,
+    diff_compression: dict | None = None,
 ) -> WorkerResult:
     """Participate in up to ``cycles`` FL cycles: authenticate → cycle
     request → download model+plan → local plan execution → report diff.
@@ -65,6 +66,7 @@ def run_worker(
             retry_wait = [0.0]
             job = client.new_job(model_name, model_version)
             job.diff_precision = diff_precision
+            job.diff_compression = diff_compression
 
             def on_accepted(job: Any) -> None:
                 plan = job.plans["training_plan"]

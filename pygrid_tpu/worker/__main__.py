@@ -24,7 +24,27 @@ def main(argv=None) -> int:
         "binary (msgpack frames, raw diff bytes), bf16 (binary + bfloat16 "
         "diff payloads)",
     )
+    parser.add_argument(
+        "--compress",
+        default=None,
+        metavar="topk:FRACTION",
+        help="sparse diff uploads, e.g. topk:0.1 — top 10%% of entries per "
+        "tensor with error feedback carrying the rest to the next cycle",
+    )
     args = parser.parse_args(argv)
+
+    compression = None
+    if args.compress:
+        scheme, _, frac = args.compress.partition(":")
+        if scheme != "topk":
+            parser.error(f"unknown compression scheme {scheme!r}")
+        try:
+            fraction = float(frac) if frac else 0.1
+        except ValueError:
+            parser.error(f"--compress fraction {frac!r} is not a number")
+        if not 0.0 < fraction <= 1.0:
+            parser.error("--compress fraction must be in (0, 1]")
+        compression = {"name": "topk", "fraction": fraction}
 
     from pygrid_tpu.worker import run_worker
 
@@ -36,6 +56,7 @@ def main(argv=None) -> int:
         cycles=args.cycles,
         wire="binary" if args.wire in ("binary", "bf16") else "json",
         diff_precision="bf16" if args.wire == "bf16" else None,
+        diff_compression=compression,
     )
     print(
         f"worker done: accepted={result.accepted} rejected={result.rejected} "

@@ -64,3 +64,9 @@ def test_encrypted_inference_example():
     result = _run("encrypted_inference.py", "--spawn")
     assert result.returncode == 0, result.stderr
     assert "encrypted inference OK" in result.stdout
+
+
+def test_advanced_fl_example():
+    result = _run("advanced_fl.py", "--spawn")
+    assert result.returncode == 0, result.stderr
+    assert "advanced FL OK" in result.stdout
