@@ -50,17 +50,20 @@ def _flops_per_round() -> float:
 
 
 def bench_tpu() -> tuple[float, float, float]:
-    """Returns (rounds/sec folded, mfu_fraction, rounds/sec per-client).
+    """Returns (rounds/sec per-client, mfu_fraction, rounds/sec folded).
 
     Two kernel shapes of the same algorithm (identical outputs — the
     identity is tested in test_fedavg_sim.py):
 
-    - *per-client*: vmapped clients, per-client diffs materialized then
-      meaned — bandwidth-bound on the [K, 784, 392] diff tensor
-      (~2.5 GB/round of HBM traffic at K=1024).
+    - *per-client*: vmapped clients — the general path (local_steps > 1,
+      stateful optimizers) and, measured on chip, the FASTER one: XLA
+      fuses the mean of per-client diffs into the producers, so the
+      [K, 784, 392] diff tensor never materializes in HBM.
     - *folded* (``fold_clients=True``): K·B samples fold into one batch
-      before the first matmul, so the round writes ONE weight update —
-      the roofline moves from bandwidth- to compute-bound (BASELINE.md).
+      before the first matmul. Its big dots run at ~86% MFU in isolation,
+      but the compiled step loses ~3 ms/round to unfused elementwise/
+      softmax passes over the 65536-row activations — measured ~2.4×
+      slower end-to-end than the per-client program (BASELINE.md).
     """
     import jax
     import jax.numpy as jnp
@@ -109,16 +112,16 @@ def bench_tpu() -> tuple[float, float, float]:
 
     dt_per_client = measure(fold=False)
     dt_folded = measure(fold=True)
-    mfu = _flops_per_round() / dt_folded / (PEAK_TFLOPS * 1e12)
     mfu_pc = _flops_per_round() / dt_per_client / (PEAK_TFLOPS * 1e12)
+    mfu_fold = _flops_per_round() / dt_folded / (PEAK_TFLOPS * 1e12)
     print(
-        f"tpu: folded {dt_folded*1e3:.2f} ms/round @ {K} clients "
-        f"({K/dt_folded:,.0f} client-updates/sec, MFU {mfu*100:.1f}%) | "
-        f"per-client {dt_per_client*1e3:.2f} ms/round "
-        f"(MFU {mfu_pc*100:.1f}%) of {PEAK_TFLOPS:.0f} TF bf16",
+        f"tpu: per-client {dt_per_client*1e3:.2f} ms/round @ {K} clients "
+        f"({K/dt_per_client:,.0f} client-updates/sec, MFU {mfu_pc*100:.1f}%) | "
+        f"folded {dt_folded*1e3:.2f} ms/round "
+        f"(MFU {mfu_fold*100:.1f}%) of {PEAK_TFLOPS:.0f} TF bf16",
         file=sys.stderr,
     )
-    return 1.0 / dt_folded, mfu, 1.0 / dt_per_client
+    return 1.0 / dt_per_client, mfu_pc, 1.0 / dt_folded
 
 
 def bench_cpu_torch_baseline() -> float:
@@ -513,9 +516,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        tpu_rps = mfu = tpu_rps_per_client = None
+        tpu_rps = mfu = tpu_rps_folded = None
     else:
-        tpu_rps, mfu, tpu_rps_per_client = bench_tpu()
+        tpu_rps, mfu, tpu_rps_folded = bench_tpu()
     proto = bench_protocol("json")
     proto.update(bench_protocol("binary"))
     if tpu_ok:
@@ -527,8 +530,8 @@ def main() -> None:
         "unit": "rounds/sec (1024 simulated MNIST-MLP clients, batch 64)",
         "vs_baseline": round(tpu_rps / cpu_rps, 1) if tpu_ok else None,
         "mfu_pct": round(mfu * 100, 1) if tpu_ok else None,
-        "fedavg_rounds_per_sec_per_client_path": (
-            round(tpu_rps_per_client, 3) if tpu_ok else None
+        "fedavg_rounds_per_sec_folded_path": (
+            round(tpu_rps_folded, 3) if tpu_ok else None
         ),
         "cpu_baseline_rounds_per_sec": round(cpu_rps, 4),
         **proto,
