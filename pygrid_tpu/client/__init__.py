@@ -14,6 +14,7 @@ from pygrid_tpu.client.data_centric import DataCentricFLClient
 from pygrid_tpu.client.fl_client import FLClient, FLJob
 from pygrid_tpu.client.model_centric import ModelCentricFLClient
 from pygrid_tpu.client.network import PublicGridNetwork
+from pygrid_tpu.client.secagg import SecAggSession
 
 __all__ = [
     "GridWSClient",
@@ -22,4 +23,5 @@ __all__ = [
     "FLJob",
     "ModelCentricFLClient",
     "PublicGridNetwork",
+    "SecAggSession",
 ]

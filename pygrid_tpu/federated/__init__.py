@@ -7,4 +7,5 @@ from pygrid_tpu.federated.managers import (  # noqa: F401
     ProtocolManager,
     WorkerManager,
 )
-from pygrid_tpu.federated import auth, schemas, tasks  # noqa: F401
+from pygrid_tpu.federated import auth, schemas, secagg, tasks  # noqa: F401
+from pygrid_tpu.federated.secagg_service import SecAggService  # noqa: F401

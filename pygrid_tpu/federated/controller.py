@@ -77,6 +77,21 @@ class FLController:
                     "averaging plan (noise is calibrated to the mean's "
                     "C/K sensitivity)"
                 )
+        from pygrid_tpu.federated.secagg_service import SecAggService
+
+        SecAggService.validate_host_config(server_config)
+        if server_config.get("secure_aggregation") is not None:
+            if server_averaging_plan is not None:
+                raise E.PyGridError(
+                    "secure_aggregation cannot run a custom averaging plan "
+                    "(the server only ever sees the masked sum, never "
+                    "individual diffs)"
+                )
+            if (client_config or {}).get("diff_compression"):
+                raise E.PyGridError(
+                    "secure_aggregation is incompatible with diff_compression "
+                    "(masks must cover every coordinate of a dense envelope)"
+                )
         process = self.process_manager.create(
             name=name,
             version=version,

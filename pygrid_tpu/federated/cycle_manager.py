@@ -71,12 +71,15 @@ class CycleManager:
         model_manager: ModelManager,
         plan_manager: PlanManager,
     ) -> None:
+        from pygrid_tpu.federated.secagg_service import SecAggService
+
         self._cycles = Warehouse(S.Cycle, db)
         self._worker_cycles = Warehouse(S.WorkerCycle, db)
         self._opt_states = Warehouse(S.ServerOptState, db)
         self.process_manager = process_manager
         self.model_manager = model_manager
         self.plan_manager = plan_manager
+        self.secagg = SecAggService(self)
         self._accum: dict[int, _DiffAccumulator] = {}
         self._accum_lock = threading.Lock()
         self._dp_cache: dict[int, dict | None] = {}
@@ -185,26 +188,53 @@ class CycleManager:
 
     # --- diff submission + completion ---------------------------------------
 
+    def resolve_worker_cycle(
+        self, worker_id: str, request_key: str
+    ) -> tuple[S.Cycle, S.WorkerCycle]:
+        """The worker's open cycle for this request_key — the one
+        resolution used by diff submission AND every secagg round."""
+        for candidate in self._worker_cycles.query(
+            worker_id=worker_id, request_key=request_key
+        ):
+            cycle = self._cycles.first(
+                id=candidate.cycle_id, is_completed=False
+            )
+            if cycle is not None:
+                return cycle, candidate
+        raise E.InvalidRequestKeyError()
+
     def submit_worker_diff(
         self, worker_id: str, request_key: str, diff: bytes
     ) -> None:
         """Store a worker's diff, then (dedup'd, possibly async) check cycle
         readiness (reference :151-178 + tasks/cycle.py)."""
-        cycle = None
-        wc = None
-        for candidate in self._worker_cycles.query(
-            worker_id=worker_id, request_key=request_key
-        ):
-            c = self._cycles.first(id=candidate.cycle_id, is_completed=False)
-            if c is not None:
-                cycle, wc = c, candidate
-                break
-        if wc is None:
-            raise E.InvalidRequestKeyError()
+        cycle, wc = self.resolve_worker_cycle(worker_id, request_key)
         if not diff:
             # an empty blob must not count toward readiness — completed rows
             # are what complete_cycle counts, so every one must carry a diff
             raise E.PyGridError("empty diff")
+        if self.secagg.config_for(cycle.fl_process_id) is not None:
+            # masked uint32 envelope: decode + shape-check + mod-2^32
+            # accumulate (raises before any state change on a bad report);
+            # the blob row still marks readiness like any other report
+            self.secagg.ingest_masked(
+                cycle.id, worker_id, diff,
+                self._model_shapes(cycle.fl_process_id),
+            )
+            self._worker_cycles.modify(
+                {"id": wc.id},
+                {
+                    "is_completed": True,
+                    "completed_at": dt.datetime.now(dt.timezone.utc).replace(
+                        tzinfo=None
+                    ),
+                    "diff": diff,
+                },
+            )
+            tasks.run_task_once(
+                f"complete_cycle_{cycle.id}", self.complete_cycle, cycle.id
+            )
+            return
         # decode BEFORE storing: a malformed blob must bounce back to the
         # reporting worker as an error, never become a stored poison row
         # that counts toward readiness and re-raises on every completion
@@ -309,16 +339,27 @@ class CycleManager:
             if wc.diff
         ]
 
-    def complete_cycle(self, cycle_id: int) -> None:
-        """Readiness: enough diffs AND (no limits OR max hit OR time up)
-        (reference :180-217)."""
+    def _cycle_context(
+        self, cycle_id: int
+    ) -> tuple[S.Cycle, S.FLProcess, dict] | None:
+        """(cycle, process, server_config) for an OPEN cycle — the shared
+        preamble of every completion door (plain, secagg, failed)."""
         cycle = self._cycles.first(id=cycle_id)
         if cycle is None or cycle.is_completed:
-            return
+            return None
         process = self.process_manager.first(id=cycle.fl_process_id)
         server_config = self.process_manager.get_configs(
             fl_process_id=process.id, is_server_config=True
         )
+        return cycle, process, server_config
+
+    def complete_cycle(self, cycle_id: int) -> None:
+        """Readiness: enough diffs AND (no limits OR max hit OR time up)
+        (reference :180-217)."""
+        context = self._cycle_context(cycle_id)
+        if context is None:
+            return
+        cycle, process, server_config = context
         # readiness needs only the COUNT — loading the diff blobs here would
         # read O(K) megabytes per report, O(K²) per cycle; the blobs are
         # fetched once, in _average_plan_diffs, when the cycle is ready
@@ -348,6 +389,13 @@ class CycleManager:
         """(reference :219-323) average diffs → new checkpoint → next cycle.
         Timed under ``cycle.aggregate`` (surfaced by /data-centric/status/)."""
         from pygrid_tpu.utils.profiling import timed
+
+        if self.secagg.config_for(process.id) is not None:
+            # masked sums cannot be averaged yet — hand the cycle to the
+            # SecAgg unmask round; it calls back finish_secagg_cycle /
+            # close_failed_cycle when the masks are resolved
+            self.secagg.begin_unmasking(cycle, server_config)
+            return
 
         with timed("cycle.aggregate"):
             if not self._worker_cycles.contains(
@@ -414,14 +462,51 @@ class CycleManager:
                     n_diffs,
                 )
 
-            new_params, opt_state = self._server_update(
-                model.id, params, avg_diff, server_config
+            self._apply_avg_and_close(
+                process, cycle, server_config, model, params, avg_diff
             )
-            self.model_manager.save(
-                model.id, serialize_model_params(new_params)
+
+    def _apply_avg_and_close(
+        self, process, cycle, server_config: dict, model, params, avg_diff
+    ) -> None:
+        """Shared tail of both aggregation doors (plain + secagg): server
+        update → checkpoint → opt state → close/spawn next cycle."""
+        new_params, opt_state = self._server_update(
+            model.id, params, avg_diff, server_config
+        )
+        self.model_manager.save(model.id, serialize_model_params(new_params))
+        self._save_opt_state(model.id, opt_state)
+        self._finish_cycle(process, cycle, server_config)
+
+    def finish_secagg_cycle(self, cycle_id: int, avg_diff: list) -> None:
+        """SecAgg callback: the unmask round resolved ``avg_diff`` (the
+        dequantized survivor mean) — apply the server update and close the
+        cycle exactly like the plain aggregation path."""
+        context = self._cycle_context(cycle_id)
+        if context is None:
+            return
+        cycle, process, server_config = context
+        from pygrid_tpu.utils.profiling import timed
+
+        with timed("cycle.aggregate"):
+            model = self.model_manager.get(fl_process_id=process.id)
+            ckpt = self.model_manager.load(model_id=model.id, alias="latest")
+            params = unserialize_model_params(ckpt.value)
+            self._apply_avg_and_close(
+                process, cycle, server_config, model, params, avg_diff
             )
-            self._save_opt_state(model.id, opt_state)
-            self._finish_cycle(process, cycle, server_config)
+
+    def close_failed_cycle(self, cycle_id: int) -> None:
+        """SecAgg callback: the cycle cannot be unmasked (too few
+        survivors/shares) — close it without a checkpoint and spawn the
+        next one so the process keeps going (the secagg analog of a
+        zero-diff deadline close)."""
+        context = self._cycle_context(cycle_id)
+        if context is None:
+            return
+        cycle, process, server_config = context
+        logger.warning("cycle %s closed without aggregation", cycle_id)
+        self._finish_cycle(process, cycle, server_config)
 
     def _server_update(
         self, model_id: int, params: list, avg_diff: list, server_config: dict

@@ -221,6 +221,56 @@ def report(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     }
 
 
+# ── secure-aggregation rounds (this framework's extension; secagg_service) ───
+
+
+def _secagg_event(msg_type: str, fn) -> Callable:
+    """Wrap a SecAggService call in the standard {type, data} envelope with
+    protocol-boundary error capture (the shape every FL event returns)."""
+
+    def handler(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+        data = message.get(MSG_FIELD.DATA) or {}
+        response: dict[str, Any] = {}
+        try:
+            response = fn(ctx.fl.cycle_manager.secagg, data)
+        except Exception as err:  # noqa: BLE001 — protocol boundary
+            response = {ERROR: str(err)}
+        return {MSG_FIELD.TYPE: msg_type, MSG_FIELD.DATA: response}
+
+    return handler
+
+
+secagg_advertise = _secagg_event(
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_ADVERTISE,
+    lambda svc, d: svc.advertise(
+        d.get(MSG_FIELD.WORKER_ID), d.get(CYCLE.KEY), d.get("public_key")
+    ),
+)
+secagg_roster = _secagg_event(
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_ROSTER,
+    lambda svc, d: svc.roster(d.get(MSG_FIELD.WORKER_ID), d.get(CYCLE.KEY)),
+)
+secagg_shares = _secagg_event(
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_SHARES,
+    lambda svc, d: svc.submit_shares(
+        d.get(MSG_FIELD.WORKER_ID), d.get(CYCLE.KEY), d.get("shares") or {}
+    ),
+)
+secagg_status = _secagg_event(
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_STATUS,
+    lambda svc, d: svc.status(d.get(MSG_FIELD.WORKER_ID), d.get(CYCLE.KEY)),
+)
+secagg_unmask = _secagg_event(
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_UNMASK,
+    lambda svc, d: svc.submit_unmask_shares(
+        d.get(MSG_FIELD.WORKER_ID),
+        d.get(CYCLE.KEY),
+        d.get("b_shares") or {},
+        d.get("sk_shares") or {},
+    ),
+)
+
+
 # ── data-centric control events (reference control_events.py) ────────────────
 
 
@@ -387,6 +437,11 @@ ROUTES: dict[str, Callable[[NodeContext, dict, Connection], dict]] = {
     MODEL_CENTRIC_FL_EVENTS.AUTHENTICATE: authenticate,
     MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST: cycle_request,
     MODEL_CENTRIC_FL_EVENTS.REPORT: report,
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_ADVERTISE: secagg_advertise,
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_ROSTER: secagg_roster,
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_SHARES: secagg_shares,
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_STATUS: secagg_status,
+    MODEL_CENTRIC_FL_EVENTS.SECAGG_UNMASK: secagg_unmask,
     REQUEST_MSG.GET_ID: get_node_infos,
     REQUEST_MSG.CONNECT_NODE: connect_grid_nodes,
     REQUEST_MSG.HOST_MODEL: host_model,

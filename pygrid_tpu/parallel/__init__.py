@@ -26,3 +26,9 @@ from pygrid_tpu.parallel.distributed import (  # noqa: F401
     hybrid_mesh,
     local_batch_slice,
 )
+from pygrid_tpu.parallel.secagg_sim import (  # noqa: F401
+    make_sharded_masked_sum,
+    mask_clients,
+    masked_sum,
+    simulate_secagg_round,
+)

@@ -53,6 +53,13 @@ class MODEL_CENTRIC_FL_EVENTS:
     REPORT = "model-centric/report"
     AUTHENTICATE = "model-centric/authenticate"
     CYCLE_REQUEST = "model-centric/cycle-request"
+    # secure-aggregation rounds (this framework's extension — the reference
+    # has no SecAgg; names follow its model-centric/<verb> convention)
+    SECAGG_ADVERTISE = "model-centric/secagg-advertise"
+    SECAGG_ROSTER = "model-centric/secagg-roster"
+    SECAGG_SHARES = "model-centric/secagg-shares"
+    SECAGG_STATUS = "model-centric/secagg-status"
+    SECAGG_UNMASK = "model-centric/secagg-unmask"
 
 
 class USER_EVENTS:

@@ -70,3 +70,9 @@ def test_advanced_fl_example():
     result = _run("advanced_fl.py", "--spawn")
     assert result.returncode == 0, result.stderr
     assert "advanced FL OK" in result.stdout
+
+
+def test_secagg_fl_example():
+    result = _run("secagg_fl.py", "--spawn")
+    assert result.returncode == 0, result.stderr
+    assert "secure aggregation OK" in result.stdout

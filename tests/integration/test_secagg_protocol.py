@@ -1,0 +1,391 @@
+"""Secure aggregation over the real cycle protocol: 4 workers run the
+Bonawitz rounds (advertise → roster → sealed shares → masked report →
+unmask) against a live node — once with full participation, once with a
+dropout whose dangling pairwise masks the survivors' Shamir shares
+reconstruct. The node only ever sees masked uint32 envelopes, and the
+final checkpoint equals plain FedAvg of the survivors' diffs to
+quantization precision.
+
+No reference analog (reference fl_events.py:237-271 ships raw diffs);
+the cycle/readiness machinery underneath is the reference's
+(cycle_manager.py:151-323)."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from pygrid_tpu.client import FLClient, ModelCentricFLClient, SecAggSession
+from pygrid_tpu.federated import secagg
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.plans.state import unserialize_model_params
+
+from .conftest import ServerThread, _free_port
+
+D, H, C, B = 20, 8, 4, 4
+CLIP = 0.5
+N_WORKERS = 4
+THRESHOLD = 3
+
+
+@pytest.fixture(scope="module")
+def node():
+    from pygrid_tpu.federated import tasks
+    from pygrid_tpu.node import create_app
+
+    prev = tasks._sync
+    tasks.set_sync(True)
+    server = ServerThread(create_app("secagg-node"), _free_port()).start()
+    yield server
+    tasks.set_sync(prev)
+    server.stop()
+
+
+def _host(node, name: str, *, min_diffs: int, max_diffs: int):
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(3), (D, H, C))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(node.url)
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": name, "version": "1.0",
+            "batch_size": B, "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": N_WORKERS,
+            "max_workers": N_WORKERS,
+            "min_diffs": min_diffs,
+            "max_diffs": max_diffs,
+            "num_cycles": 1,
+            "do_not_reuse_workers_until_cycle": 0,
+            "pool_selection": "random",
+            "secure_aggregation": {
+                "clip_range": CLIP,
+                "threshold": THRESHOLD,
+                "phase_timeout": 15.0,
+            },
+        },
+    )
+    assert resp.get("status") == "success", resp
+    mc.close()
+    return params
+
+
+def _worker_diff(i: int, params) -> list[np.ndarray]:
+    rng = np.random.default_rng(100 + i)
+    return [rng.normal(0, 0.01, p.shape).astype(np.float32) for p in params]
+
+
+def _run_worker(
+    node, name: str, i: int, params, results: dict, *, drop: bool
+) -> None:
+    try:
+        client = FLClient(node.url, timeout=30.0)
+        auth = client.authenticate(name, "1.0")
+        wid = auth["worker_id"]
+        cyc = client.cycle_request(
+            wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+        )
+        assert cyc.get("status") == "accepted", cyc
+        session = SecAggSession(client, wid, cyc["request_key"])
+        session.advertise()
+        session.wait_roster(timeout=20.0)
+        session.upload_shares()
+        session.wait_masking(timeout=20.0)
+        if drop:
+            results[i] = ("dropped", None)
+            client.close()
+            return
+        diffs = _worker_diff(i, params)
+        session.report(diffs)
+        phase = session.finish(timeout=40.0)
+        results[i] = (phase, diffs)
+        client.close()
+    except Exception as err:  # noqa: BLE001 — surfaced by the assertion
+        results[i] = ("error", err)
+
+
+def _run_round(node, name: str, params, drop_idx: int | None):
+    results: dict[int, tuple] = {}
+    threads = [
+        threading.Thread(
+            target=_run_worker,
+            args=(node, name, i, params, results),
+            kwargs={"drop": i == drop_idx},
+            daemon=True,
+        )
+        for i in range(N_WORKERS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert len(results) == N_WORKERS, f"workers stuck: {sorted(results)}"
+    errors = {i: r for i, r in results.items() if r[0] == "error"}
+    assert not errors, f"worker errors: {errors}"
+    return results
+
+
+def _check_aggregation(node, name, params, results, n_for_scale):
+    mc = ModelCentricFLClient(node.url)
+    latest = mc.retrieve_model(name, "1.0")
+    mc.close()
+    new_params = latest
+    survivor_diffs = [d for phase, d in results.values() if d is not None]
+    expected = [
+        p - np.mean([d[k] for d in survivor_diffs], axis=0)
+        for k, p in enumerate(params)
+    ]
+    step = 1.0 / secagg.choose_scale(CLIP, n_for_scale)
+    for got, want in zip(new_params, expected):
+        np.testing.assert_allclose(
+            np.asarray(got), want, atol=n_for_scale * step + 1e-6
+        )
+
+
+def test_secagg_full_participation(node):
+    """All 4 report; pairwise masks cancel in the node's accumulator and
+    the unmask round only removes self-masks."""
+    name = "secagg-full"
+    params = _host(node, name, min_diffs=N_WORKERS, max_diffs=N_WORKERS)
+    results = _run_round(node, name, params, drop_idx=None)
+    assert all(phase in ("done", "closed") for phase, _ in results.values())
+    _check_aggregation(node, name, params, results, N_WORKERS)
+
+
+def test_secagg_with_dropout(node):
+    """Worker 3 completes the key rounds then vanishes before reporting:
+    readiness fires at min_diffs=3, survivors reconstruct the dropout's
+    DH secret (3-of-4 Shamir) and the checkpoint equals the survivors'
+    plain mean."""
+    name = "secagg-drop"
+    params = _host(node, name, min_diffs=THRESHOLD, max_diffs=THRESHOLD)
+    results = _run_round(node, name, params, drop_idx=3)
+    assert results[3][0] == "dropped"
+    survivors = [r for i, r in results.items() if i != 3]
+    assert all(phase in ("done", "closed") for phase, _ in survivors)
+    _check_aggregation(node, name, params, results, N_WORKERS)
+
+
+def test_secagg_rejects_plain_diff(node):
+    """A raw (unmasked) State blob against a secagg process must bounce
+    at ingest — a single honest-but-curious-server-visible diff would
+    break the aggregate-only guarantee."""
+    from pygrid_tpu.plans.state import serialize_model_params
+
+    name = "secagg-reject"
+    params = _host(node, name, min_diffs=THRESHOLD, max_diffs=THRESHOLD)
+    client = FLClient(node.url, timeout=30.0)
+    auth = client.authenticate(name, "1.0")
+    wid = auth["worker_id"]
+    cyc = client.cycle_request(
+        wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cyc.get("status") == "accepted", cyc
+    blob = serialize_model_params(_worker_diff(0, params))
+    out = client.report(wid, cyc["request_key"], blob)
+    assert "error" in out, out
+    client.close()
+
+
+def test_secagg_partial_roster_proceeds(node):
+    """Only 3 of max_workers=4 ever show up: the advertise grace expires
+    and the round proceeds with the 3 who advertised (≥ threshold) instead
+    of stalling until the cycle deadline."""
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(3), (D, H, C))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    name = "secagg-partial"
+    mc = ModelCentricFLClient(node.url)
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": name, "version": "1.0",
+            "batch_size": B, "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": 3, "max_workers": N_WORKERS,
+            "min_diffs": 3, "max_diffs": 3, "num_cycles": 1,
+            "do_not_reuse_workers_until_cycle": 0,
+            "pool_selection": "random",
+            "secure_aggregation": {
+                "clip_range": CLIP, "threshold": 3, "phase_timeout": 1.0,
+            },
+        },
+    )
+    assert resp.get("status") == "success", resp
+    mc.close()
+    results: dict[int, tuple] = {}
+    threads = [
+        threading.Thread(
+            target=_run_worker,
+            args=(node, name, i, params, results),
+            kwargs={"drop": False},
+            daemon=True,
+        )
+        for i in range(3)  # the 4th never appears
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    errors = {i: r for i, r in results.items() if r[0] == "error"}
+    assert not errors, f"worker errors: {errors}"
+    # scale is len(mask_set)=3 on both ends
+    _check_aggregation(node, name, params, results, 3)
+
+
+def test_secagg_corrupt_share_fails_cycle_cleanly(node):
+    """Two survivors submit garbage share material (two, so every
+    threshold-size reconstruction subset contains at least one — a single
+    corrupt share among n > t honest ones can legitimately be tolerated by
+    redundancy): reconstruction fails and the cycle closes FAILED (model
+    unchanged) instead of wedging the process forever."""
+    name = "secagg-corrupt"
+    params = _host(node, name, min_diffs=N_WORKERS, max_diffs=N_WORKERS)
+
+    def corrupting_worker(i: int, results: dict) -> None:
+        try:
+            client = FLClient(node.url, timeout=30.0)
+            auth = client.authenticate(name, "1.0")
+            wid = auth["worker_id"]
+            cyc = client.cycle_request(
+                wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+            )
+            session = SecAggSession(client, wid, cyc["request_key"])
+            session.advertise()
+            session.wait_roster(timeout=20.0)
+            session.upload_shares()
+            session.wait_masking(timeout=20.0)
+            session.report(_worker_diff(i, params))
+            if i in (0, 1):
+                # garble every b-share this worker will reveal: its own kept
+                # share AND its decryption path (monkeypatch the decrypt)
+                session._own_shares["b"] = (
+                    session._own_shares["b"][0],
+                    secagg.SHAMIR_PRIME - 12345,
+                )
+                real_decrypt = session._decrypt_share
+
+                def corrupt(from_wid):
+                    entry = real_decrypt(from_wid)
+                    entry["b"] = secagg.int_to_hex(secagg.SHAMIR_PRIME - 999)
+                    return entry
+
+                session._decrypt_share = corrupt
+            results[i] = (session.finish(timeout=40.0), None)
+            client.close()
+        except Exception as err:  # noqa: BLE001
+            results[i] = ("error", err)
+
+    results: dict[int, tuple] = {}
+    threads = [
+        threading.Thread(target=corrupting_worker, args=(i, results), daemon=True)
+        for i in range(N_WORKERS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    # no worker hangs, and the model was NOT updated (cycle closed failed)
+    assert len(results) == N_WORKERS, f"workers stuck: {sorted(results)}"
+    errors = {i: r for i, r in results.items() if r[0] == "error"}
+    assert not errors, f"worker errors: {errors}"
+    mc = ModelCentricFLClient(node.url)
+    latest = mc.retrieve_model(name, "1.0")
+    mc.close()
+    for got, want in zip(latest, params):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_secagg_incomplete_share_bundle_rejected(node):
+    """A bundle that doesn't cover every roster peer is rejected at
+    submission (it would otherwise doom the cycle at unmask time)."""
+    name = "secagg-incomplete"
+    params = _host(node, name, min_diffs=THRESHOLD, max_diffs=THRESHOLD)
+    sessions = []
+    clients = []
+    for i in range(N_WORKERS):
+        client = FLClient(node.url, timeout=30.0)
+        wid = client.authenticate(name, "1.0")["worker_id"]
+        cyc = client.cycle_request(
+            wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+        )
+        assert cyc.get("status") == "accepted", cyc
+        sessions.append(SecAggSession(client, wid, cyc["request_key"]))
+        clients.append(client)
+    for s in sessions:
+        s.advertise()
+    for s in sessions:
+        s.wait_roster(timeout=20.0)
+    # hand-build an empty bundle for worker 0 — must bounce
+    from pygrid_tpu.utils.codes import MODEL_CENTRIC_FL_EVENTS
+    from pygrid_tpu.utils.exceptions import PyGridError
+
+    with pytest.raises(PyGridError, match="share bundle must cover"):
+        sessions[0]._send(MODEL_CENTRIC_FL_EVENTS.SECAGG_SHARES, shares={})
+    # the real (complete) bundle still goes through afterwards
+    out = sessions[0].upload_shares()
+    assert out.get("status") == "ok"
+    for c in clients:
+        c.close()
+
+
+def test_secagg_host_rejects_bad_configs(node):
+    mc = ModelCentricFLClient(node.url)
+    params = [np.zeros((4, 2), np.float32)]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, 4), np.float32),
+        np.zeros((B, 2), np.float32),
+        np.float32(0.1),
+        params[0],
+        np.zeros((2,), np.float32),
+    )
+    base = {
+        "min_workers": 2, "max_workers": 2,
+        "min_diffs": 2, "max_diffs": 2, "num_cycles": 1,
+    }
+    from pygrid_tpu.utils.exceptions import PyGridError
+
+    for server_config in (
+        {**base, "secure_aggregation": {"clip_range": -1.0}},
+        {**base, "secure_aggregation": {"clip_range": 0.5},
+         "differential_privacy": {"clip_norm": 1.0}},
+        {**base, "secure_aggregation": "yes"},
+        {**base, "secure_aggregation": {"clip_range": 0.5}, "max_workers": 1,
+         "min_workers": 1},
+    ):
+        with pytest.raises(PyGridError):
+            mc.host_federated_training(
+                model=params + [np.zeros((2,), np.float32)],
+                client_plans={"training_plan": plan},
+                client_config={
+                    "name": "secagg-bad", "version": "1.0",
+                    "batch_size": B, "lr": 0.1, "max_updates": 1,
+                },
+                server_config=server_config,
+            )
+    mc.close()
