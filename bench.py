@@ -248,6 +248,64 @@ def bench_smpc() -> dict:
     }
 
 
+def bench_attention() -> dict:
+    """Causal attention L=4096 H=8 D=128 bf16: the Pallas flash kernel
+    (`parallel.pallas_attention`) vs the XLA dense path
+    (`parallel.ring_attention.attention`) — same computation, chained
+    marginal timing (tunnel-safe)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from pygrid_tpu.parallel.pallas_attention import flash_attention
+    from pygrid_tpu.parallel.ring_attention import attention
+
+    B, L, H, D = 1, 4096, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, L, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, L, H, D), jnp.bfloat16)
+
+    def marginal(fn, lo=2, hi=42, trials=5):
+        def chain(n):
+            @jax.jit
+            def f(x):
+                for _ in range(n):
+                    x = fn(x * (1.0 + 1e-6), k, v)
+                return x
+            return f
+
+        fns = {n: chain(n) for n in (lo, hi)}
+        for f in fns.values():
+            out = f(q)
+            _ = float(out.astype(jnp.float32).ravel()[0])
+
+        def run(n):
+            t0 = time.perf_counter()
+            out = fns[n](q)
+            _ = float(out.astype(jnp.float32).ravel()[0])
+            return time.perf_counter() - t0
+
+        t_lo = min(run(lo) for _ in range(trials))
+        t_hi = min(run(hi) for _ in range(trials))
+        return (t_hi - t_lo) / (hi - lo)
+
+    t_flash = marginal(functools.partial(flash_attention, causal=True))
+    t_xla = marginal(functools.partial(attention, causal=True))
+    print(
+        f"attention[causal L={L} H={H} D={D} bf16]: "
+        f"flash {t_flash*1e3:.3f} ms vs xla {t_xla*1e3:.3f} ms "
+        f"({t_xla/t_flash:.2f}x)",
+        file=sys.stderr,
+    )
+    return {
+        "attention_flash_ms": round(t_flash * 1e3, 3),
+        "attention_xla_ms": round(t_xla * 1e3, 3),
+        "attention_flash_speedup": round(t_xla / t_flash, 2),
+    }
+
+
 # --- protocol plane ----------------------------------------------------------
 
 
@@ -523,6 +581,7 @@ def main() -> None:
     proto.update(bench_protocol("binary"))
     if tpu_ok:
         proto.update(bench_smpc())
+        proto.update(bench_attention())
     cpu_rps = bench_cpu_torch_baseline()
     result = {
         "metric": "fedavg_rounds_per_sec_1k_clients",

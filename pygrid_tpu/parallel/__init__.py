@@ -32,3 +32,4 @@ from pygrid_tpu.parallel.secagg_sim import (  # noqa: F401
     masked_sum,
     simulate_secagg_round,
 )
+from pygrid_tpu.parallel.pallas_attention import flash_attention  # noqa: F401

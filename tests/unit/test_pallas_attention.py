@@ -1,0 +1,99 @@
+"""Pallas flash attention (parallel/pallas_attention.py) vs the XLA
+reference, kernel run in interpret mode on CPU (the house pattern from
+test_pallas_kernels.py). No reference analog — the reference has no
+attention anywhere (SURVEY §5.7)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pygrid_tpu.parallel.pallas_attention import flash_attention
+from pygrid_tpu.parallel.ring_attention import attention
+
+
+def _qkv(B, Lq, Lk, H, D, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(ks[0], (B, Lq, H, D), dtype),
+        jax.random.normal(ks[1], (B, Lk, H, D), dtype),
+        jax.random.normal(ks[2], (B, Lk, H, D), dtype),
+    )
+
+
+@pytest.mark.parametrize(
+    "B,Lq,Lk,H,D,causal",
+    [
+        (2, 128, 128, 2, 64, False),
+        (1, 256, 256, 4, 64, True),
+        (2, 200, 200, 2, 32, True),    # ragged lengths, tiny head dim
+        (1, 100, 300, 2, 64, False),   # cross-attention, ragged
+        (1, 384, 384, 1, 128, True),   # full-width head dim
+    ],
+)
+def test_matches_xla_reference(B, Lq, Lk, H, D, causal):
+    q, k, v = _qkv(B, Lq, Lk, H, D)
+    ref = attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
+
+
+def test_block_sizes_do_not_change_the_answer():
+    q, k, v = _qkv(1, 300, 300, 2, 64)
+    base = flash_attention(q, k, v, causal=True, interpret=True)
+    for bq, bk in [(128, 128), (256, 128), (128, 256)]:
+        other = flash_attention(
+            q, k, v, causal=True, interpret=True, block_q=bq, block_k=bk
+        )
+        np.testing.assert_allclose(
+            np.asarray(other), np.asarray(base), atol=2e-5
+        )
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(1, 256, 256, 2, 64, dtype=jnp.bfloat16)
+    ref = attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=True,
+    )
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), np.asarray(ref), atol=3e-2
+    )
+
+
+def test_scale_override():
+    q, k, v = _qkv(1, 128, 128, 1, 64)
+    ref = attention(q, k, v, scale=0.5)
+    got = flash_attention(q, k, v, scale=0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
+
+
+def test_causal_requires_square():
+    q, k, v = _qkv(1, 128, 256, 1, 64)
+    with pytest.raises(ValueError, match="Lq == Lk"):
+        flash_attention(q, k, v, causal=True, interpret=True)
+
+
+def test_plugs_into_transformer_attn_fn():
+    """The kernel satisfies the transformer's injectable attn_fn contract
+    (same [B, L, H, D] signature as `attention`)."""
+    from functools import partial
+
+    from pygrid_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=1, max_len=64
+    )
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    X = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 64)
+    ref_logits = transformer.apply(params, X, cfg)
+    flash_logits = transformer.apply(
+        params, X, cfg,
+        attn_fn=partial(flash_attention, interpret=True),
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash_logits), np.asarray(ref_logits), atol=1e-4
+    )
