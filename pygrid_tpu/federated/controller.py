@@ -77,6 +77,39 @@ class FLController:
                     "averaging plan (noise is calibrated to the mean's "
                     "C/K sensitivity)"
                 )
+        async_cfg = server_config.get("async_aggregation")
+        if async_cfg is not None:
+            if not isinstance(async_cfg, dict):
+                raise E.PyGridError(
+                    "async_aggregation must be a dict {buffer_size, "
+                    "staleness_power}"
+                )
+            buffer_size = async_cfg.get("buffer_size")
+            if not isinstance(buffer_size, int) or buffer_size < 1:
+                raise E.PyGridError(
+                    "async_aggregation requires an integer buffer_size >= 1"
+                )
+            power = async_cfg.get("staleness_power", 0.5)
+            if not isinstance(power, (int, float)) or power < 0:
+                raise E.PyGridError("staleness_power must be >= 0")
+            if server_averaging_plan is not None:
+                raise E.PyGridError(
+                    "async_aggregation pre-reduces reports into a weighted "
+                    "buffer — a custom averaging plan never sees them"
+                )
+            if dp is not None:
+                raise E.PyGridError(
+                    "async_aggregation cannot be combined with "
+                    "differential_privacy (noise calibration assumes the "
+                    "unweighted mean; staleness weights change sensitivity)"
+                )
+            if server_config.get("secure_aggregation") is not None:
+                raise E.PyGridError(
+                    "async_aggregation cannot be combined with "
+                    "secure_aggregation (per-report staleness weights need "
+                    "individually visible reports)"
+                )
+
         from pygrid_tpu.federated.secagg_service import SecAggService
 
         SecAggService.validate_host_config(server_config)
@@ -128,12 +161,18 @@ class FLController:
         # so the WS and HTTP admission paths cannot drift
         from pygrid_tpu.federated.selection import eligibility_reason
 
+        async_cfg = server_config.get("async_aggregation")
+        already_in_cycle = (
+            # FedBuff: a worker that reported may rejoin at once — only an
+            # outstanding (un-reported) assignment blocks re-admission
+            self.cycle_manager.has_open_assignment(process.id, worker.id)
+            if async_cfg
+            else self.cycle_manager.is_assigned(cycle.id, worker.id)
+        )
         reject_reason = eligibility_reason(
             server_config=server_config,
             cycle_sequence=cycle.sequence,
-            already_in_cycle=self.cycle_manager.is_assigned(
-                cycle.id, worker.id
-            ),
+            already_in_cycle=already_in_cycle,
             last_participation=self.cycle_manager.last_participation(
                 process.id, worker.id
             ),
@@ -151,8 +190,16 @@ class FLController:
             return response
 
         request_key = self._generate_hash_key()
-        self.cycle_manager.assign(cycle, worker.id, request_key)
         model = self.model_manager.get(fl_process_id=process.id)
+        assigned_checkpoint = 0
+        if async_cfg:
+            # staleness baseline: the checkpoint this worker trains from
+            # (number only — no blob read on the request path)
+            assigned_checkpoint = self.model_manager.latest_number(model.id)
+        self.cycle_manager.assign(
+            cycle, worker.id, request_key,
+            assigned_checkpoint=assigned_checkpoint,
+        )
         return {
             CYCLE.STATUS: CYCLE.ACCEPTED,
             CYCLE.KEY: request_key,

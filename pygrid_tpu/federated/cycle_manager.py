@@ -40,27 +40,44 @@ logger = logging.getLogger(__name__)
 
 
 class _DiffAccumulator:
-    """Running per-parameter sum of a cycle's diffs (float64 on host).
+    """Running per-parameter (optionally weighted) sum of a cycle's diffs
+    (float64 on host).
 
     Submit-time accumulation amortizes the reduction across reports; the
     float64 carry keeps the mean exact to f32 resolution regardless of K
     (a left-fold in f32 loses ~log2(K) bits; the reference's
-    ``reduce(th.add)`` has the same flaw)."""
+    ``reduce(th.add)`` has the same flaw). Weights serve the async
+    (FedBuff) path — staleness-discounted contributions — and default to
+    1, which makes ``mean()`` the plain arithmetic mean."""
 
     def __init__(self) -> None:
         self.count = 0
+        self.weight_sum = 0.0
         self.sums: list[np.ndarray] | None = None
 
-    def add(self, diff: list[np.ndarray]) -> None:
+    def add(self, diff: list[np.ndarray], weight: float = 1.0) -> None:
         if self.sums is None:
-            self.sums = [np.asarray(t, dtype=np.float64) for t in diff]
+            self.sums = [
+                np.asarray(t, dtype=np.float64) * weight for t in diff
+            ]
         else:
             for s, t in zip(self.sums, diff):
-                s += np.asarray(t)
+                s += np.asarray(t, dtype=np.float64) * weight
         self.count += 1
+        self.weight_sum += weight
 
     def mean(self) -> list[np.ndarray]:
-        return [(s / self.count).astype(np.float32) for s in self.sums]
+        return [
+            (s / self.weight_sum).astype(np.float32) for s in self.sums
+        ]
+
+
+def staleness_weight(staleness: int, power: float = 0.5) -> float:
+    """FedBuff's staleness discount: ``(1 + s)^-p`` (Nguyen et al.,
+    "Federated Learning with Buffered Asynchronous Aggregation", AISTATS
+    '22 — their default p=1/2). s = checkpoints published since the
+    worker downloaded its base model."""
+    return float((1 + max(0, staleness)) ** (-power))
 
 
 class CycleManager:
@@ -83,6 +100,12 @@ class CycleManager:
         self._accum: dict[int, _DiffAccumulator] = {}
         self._accum_lock = threading.Lock()
         self._dp_cache: dict[int, dict | None] = {}
+        self._async_cache: dict[int, dict | None] = {}
+        # the FedBuff buffer is PROCESS-scoped, not cycle-scoped: an ingest
+        # racing a flush then lands either before the pop (flushed now) or
+        # after (first entry of the next buffer) — no orphaned cycle-keyed
+        # accumulator a finishing cycle could silently discard
+        self._async_accum: dict[int, _DiffAccumulator] = {}
         self._shape_cache: dict[int, list[tuple]] = {}
         self._deadline_timers: dict[int, threading.Timer] = {}
         # avg-plan presence is immutable after hosting — cached so the hot
@@ -157,14 +180,35 @@ class CycleManager:
 
     # --- worker assignment --------------------------------------------------
 
-    def assign(self, cycle: S.Cycle, worker_id: str, request_key: str) -> S.WorkerCycle:
+    def assign(
+        self,
+        cycle: S.Cycle,
+        worker_id: str,
+        request_key: str,
+        assigned_checkpoint: int = 0,
+    ) -> S.WorkerCycle:
         return self._worker_cycles.register(
             cycle_id=cycle.id,
             worker_id=worker_id,
             request_key=request_key,
             started_at=dt.datetime.now(dt.timezone.utc).replace(tzinfo=None),
             is_completed=False,
+            assigned_checkpoint=assigned_checkpoint,
         )
+
+    def has_open_assignment(self, fl_process_id: int, worker_id: str) -> bool:
+        """An assignment the worker has not yet reported against, in ANY
+        cycle of the process — the async re-admission gate. Stale keys stay
+        reportable via re-homing, so an un-reported key from a flushed
+        cycle must block a new one or a worker could hold several live
+        keys and stack contributions in a single buffer."""
+        for wc in self._worker_cycles.query(
+            worker_id=worker_id, is_completed=False
+        ):
+            cycle = self._cycles.first(id=wc.cycle_id)
+            if cycle is not None and cycle.fl_process_id == fl_process_id:
+                return True
+        return False
 
     def count_cycles(self, **filters: Any) -> int:
         return self._cycles.count(**filters)
@@ -189,10 +233,13 @@ class CycleManager:
     # --- diff submission + completion ---------------------------------------
 
     def resolve_worker_cycle(
-        self, worker_id: str, request_key: str
+        self, worker_id: str, request_key: str, include_completed: bool = False
     ) -> tuple[S.Cycle, S.WorkerCycle]:
         """The worker's open cycle for this request_key — the one
-        resolution used by diff submission AND every secagg round."""
+        resolution used by diff submission AND every secagg round.
+        ``include_completed`` (the async path) also resolves keys whose
+        cycle already flushed: a stale report re-homes to the current
+        buffer instead of bouncing."""
         for candidate in self._worker_cycles.query(
             worker_id=worker_id, request_key=request_key
         ):
@@ -201,6 +248,10 @@ class CycleManager:
             )
             if cycle is not None:
                 return cycle, candidate
+            if include_completed:
+                cycle = self._cycles.first(id=candidate.cycle_id)
+                if cycle is not None:
+                    return cycle, candidate
         raise E.InvalidRequestKeyError()
 
     def submit_worker_diff(
@@ -208,7 +259,19 @@ class CycleManager:
     ) -> None:
         """Store a worker's diff, then (dedup'd, possibly async) check cycle
         readiness (reference :151-178 + tasks/cycle.py)."""
-        cycle, wc = self.resolve_worker_cycle(worker_id, request_key)
+        try:
+            cycle, wc = self.resolve_worker_cycle(worker_id, request_key)
+        except E.InvalidRequestKeyError:
+            # a key whose cycle already flushed is still good on an async
+            # (FedBuff) process — the report re-homes to the current buffer
+            cycle, wc = self.resolve_worker_cycle(
+                worker_id, request_key, include_completed=True
+            )
+            if self._async_config(cycle.fl_process_id) is None:
+                raise E.InvalidRequestKeyError() from None
+        if self._async_config(cycle.fl_process_id) is not None:
+            self._submit_async(cycle, wc, diff)
+            return
         if not diff:
             # an empty blob must not count toward readiness — completed rows
             # are what complete_cycle counts, so every one must carry a diff
@@ -238,20 +301,9 @@ class CycleManager:
         # decode BEFORE storing: a malformed blob must bounce back to the
         # reporting worker as an error, never become a stored poison row
         # that counts toward readiness and re-raises on every completion
-        # attempt (decode_diff validates worker-supplied sparse envelopes)
-        try:
-            decoded = decode_diff(diff)
-        except Exception as err:
-            raise E.PyGridError(f"undecodable diff: {err}") from err
-        # a decodable diff with the wrong arity/shapes is just as poisonous
-        # as a malformed one: zip() in the accumulator would silently
-        # truncate, broadcasting would silently corrupt — reject exactly
-        expected = self._model_shapes(cycle.fl_process_id)
-        got = [tuple(np.shape(t)) for t in decoded]
-        if got != expected:
-            raise E.PyGridError(
-                f"diff shapes {got} do not match model shapes {expected}"
-            )
+        # attempt (a wrong-shaped diff is just as poisonous — zip() in the
+        # accumulator would silently truncate)
+        decoded = self._decode_and_check(diff, cycle.fl_process_id)
         self._worker_cycles.modify(
             {"id": wc.id},
             {
@@ -285,6 +337,73 @@ class CycleManager:
                 with self._accum_lock:
                     self._accum.pop(cycle.id, None)
         tasks.run_task_once(f"complete_cycle_{cycle.id}", self.complete_cycle, cycle.id)
+
+    def _decode_and_check(self, diff: bytes, fl_process_id: int) -> list:
+        """The one report-validation door (sync + async): non-empty,
+        decodable, shapes match the hosted model — a bad blob bounces to
+        the reporting worker before any state changes."""
+        if not diff:
+            raise E.PyGridError("empty diff")
+        try:
+            decoded = decode_diff(diff)
+        except Exception as err:
+            raise E.PyGridError(f"undecodable diff: {err}") from err
+        expected = self._model_shapes(fl_process_id)
+        got = [tuple(np.shape(t)) for t in decoded]
+        if got != expected:
+            raise E.PyGridError(
+                f"diff shapes {got} do not match model shapes {expected}"
+            )
+        return decoded
+
+    def _submit_async(self, origin_cycle: S.Cycle, wc: S.WorkerCycle, diff: bytes) -> None:
+        """FedBuff ingest: decode, staleness-weight, fold into the
+        process's buffer (regardless of which cycle the key was minted
+        in)."""
+        if wc.is_completed:
+            raise E.PyGridError("already reported for this assignment")
+        pid = origin_cycle.fl_process_id
+        decoded = self._decode_and_check(diff, pid)
+        cfg = self._async_config(pid)
+        model = self.model_manager.get(fl_process_id=pid)
+        latest_number = self.model_manager.latest_number(model.id)
+        base = wc.assigned_checkpoint or latest_number
+        weight = staleness_weight(
+            latest_number - base, float(cfg.get("staleness_power", 0.5))
+        )
+        open_cycle = self.last(pid)
+        self._worker_cycles.modify(
+            {"id": wc.id},
+            {
+                "is_completed": True,
+                "completed_at": dt.datetime.now(dt.timezone.utc).replace(
+                    tzinfo=None
+                ),
+                "diff": diff,
+            },
+        )
+        with self._accum_lock:
+            acc = self._async_accum.setdefault(pid, _DiffAccumulator())
+            acc.add(decoded, weight)
+        tasks.run_task_once(
+            f"complete_cycle_{open_cycle.id}", self.complete_cycle,
+            open_cycle.id,
+        )
+
+    def _async_config(self, fl_process_id: int) -> dict | None:
+        """The process's async_aggregation (FedBuff) server_config (cached
+        — immutable after hosting)."""
+        cached = self._async_cache.get(fl_process_id, _UNSET)
+        if cached is _UNSET:
+            server_config = self.process_manager.get_configs(
+                fl_process_id=fl_process_id, is_server_config=True
+            )
+            raw = server_config.get("async_aggregation")
+            if raw is not None and not isinstance(raw, dict):
+                raise E.PyGridError("async_aggregation must be a dict")
+            cached = raw or None
+            self._async_cache[fl_process_id] = cached
+        return cached
 
     def _model_shapes(self, fl_process_id: int) -> list[tuple]:
         """Expected diff tensor shapes — the model's parameter shapes, fixed
@@ -360,6 +479,26 @@ class CycleManager:
         if context is None:
             return
         cycle, process, server_config = context
+        async_cfg = self._async_config(process.id)
+        if async_cfg is not None:
+            # FedBuff readiness: the process buffer holds re-homed stale
+            # reports too, so IT is the count — worker-cycle rows are not
+            with self._accum_lock:
+                acc = self._async_accum.get(process.id)
+                received = acc.count if acc is not None else 0
+            time_up = cycle.end is not None and dt.datetime.now(
+                dt.timezone.utc
+            ).replace(tzinfo=None) >= cycle.end
+            if received >= int(async_cfg["buffer_size"]) or (
+                time_up and received >= 1
+            ):
+                self._average_plan_diffs(process, cycle, server_config)
+            else:
+                logger.info(
+                    "async cycle %s buffer %s/%s", cycle_id, received,
+                    async_cfg["buffer_size"],
+                )
+            return
         # readiness needs only the COUNT — loading the diff blobs here would
         # read O(K) megabytes per report, O(K²) per cycle; the blobs are
         # fetched once, in _average_plan_diffs, when the cycle is ready
@@ -395,6 +534,30 @@ class CycleManager:
             # SecAgg unmask round; it calls back finish_secagg_cycle /
             # close_failed_cycle when the masks are resolved
             self.secagg.begin_unmasking(cycle, server_config)
+            return
+
+        if self._async_config(process.id) is not None:
+            # FedBuff flush: the weighted buffer IS the aggregate. The
+            # buffer is in-memory only — a node restarted mid-buffer
+            # starts the next buffer empty (stored wc diffs keep the
+            # parity/audit surface, but their staleness context is gone)
+            with timed("cycle.aggregate"):
+                with self._accum_lock:
+                    acc = self._async_accum.pop(process.id, None)
+                if acc is None or acc.count == 0:
+                    logger.info(
+                        "async cycle %s closed with empty buffer", cycle.id
+                    )
+                    self._finish_cycle(process, cycle, server_config)
+                    return
+                model = self.model_manager.get(fl_process_id=process.id)
+                ckpt = self.model_manager.load(
+                    model_id=model.id, alias="latest"
+                )
+                params = unserialize_model_params(ckpt.value)
+                self._apply_avg_and_close(
+                    process, cycle, server_config, model, params, acc.mean()
+                )
             return
 
         with timed("cycle.aggregate"):

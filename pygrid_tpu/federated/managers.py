@@ -228,6 +228,14 @@ class ModelManager:
             raise E.CheckPointNotFound()
         return ckpt
 
+    def latest_number(self, model_id: int) -> int:
+        """The newest checkpoint's ``number`` WITHOUT loading its blob —
+        ``save`` numbers checkpoints 1..count, so the count IS the latest
+        number. The async (FedBuff) staleness paths call this per report /
+        per cycle-request; a megabyte row read there would violate the
+        hot-path rule (_model_shapes' docstring)."""
+        return self._checkpoints.count(model_id=model_id)
+
     def load_encoded(self, model_id: int, precision: str | None = None) -> bytes:
         """Latest checkpoint blob, optionally re-encoded bf16 for the wire
         (half the download bytes). Checkpoints are immutable per id, so

@@ -51,6 +51,10 @@ class WorkerCycle:
     is_completed: bool = False
     completed_at: dt.datetime | None = None
     diff: bytes | None = None
+    #: checkpoint number current when this worker was assigned — async
+    #: (FedBuff) aggregation weights its eventual report by how many
+    #: checkpoints landed in between (staleness); 0 for sync processes
+    assigned_checkpoint: int = 0
 
 
 @dataclass

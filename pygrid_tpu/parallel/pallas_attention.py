@@ -188,6 +188,14 @@ def flash_attention(
         (1, block_q, Dp), lambda bh, qi, ki: (bh, qi, 0),
         memory_space=pltpu.VMEM,
     )
+    # under shard_map the output inherits the inputs' varying mesh axes —
+    # the vma must be declared on the out_shape or check_vma rejects it
+    vma = getattr(jax.typeof(qf), "vma", None)
+    out_struct = (
+        jax.ShapeDtypeStruct((B * H, Lqp, Dp), q.dtype, vma=vma)
+        if vma
+        else jax.ShapeDtypeStruct((B * H, Lqp, Dp), q.dtype)
+    )
     out = pl.pallas_call(
         partial(
             _flash_kernel,
@@ -197,7 +205,7 @@ def flash_attention(
         grid=(B * H, Lqp // block_q, n_k),
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=o_spec,
-        out_shape=jax.ShapeDtypeStruct((B * H, Lqp, Dp), q.dtype),
+        out_shape=out_struct,
         scratch_shapes=[
             pltpu.VMEM((block_q, Dp), jnp.float32),
             pltpu.VMEM((block_q, MIN_D), jnp.float32),

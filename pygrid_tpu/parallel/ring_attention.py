@@ -145,6 +145,7 @@ def ulysses_attention(
     axis: str = "seq",
     causal: bool = False,
     scale: float | None = None,
+    attn_fn=None,
 ) -> jax.Array:
     """Exact attention via head↔sequence all-to-all re-sharding.
 
@@ -152,6 +153,11 @@ def ulysses_attention(
     ``all_to_all`` turns the local [B, L/P, H, D] into [B, L, H/P, D] (full
     sequence, local head group), dense attention runs per head group, and a
     second ``all_to_all`` restores sequence sharding.
+
+    ``attn_fn`` swaps the per-head-group dense attention — pass
+    :func:`pygrid_tpu.parallel.pallas_attention.flash_attention` to run the
+    Pallas kernel inside the all-to-all scheme (full sequence per device,
+    so the O(L²)→O(L) memory win applies where it matters most).
     """
     p_sz = mesh.shape[axis]
     if q.shape[2] % p_sz != 0:
@@ -159,18 +165,25 @@ def ulysses_attention(
             f"ulysses needs heads ({q.shape[2]}) divisible by mesh axis "
             f"{axis!r} ({p_sz}); use ring_attention instead"
         )
+    attn = attn_fn or attention
 
     def inner(q, k, v):
         a2a = partial(
             lax.all_to_all, axis_name=axis, split_axis=2, concat_axis=1,
             tiled=True,
         )
-        out = attention(a2a(q), a2a(k), a2a(v), causal=causal, scale=scale)
+        out = attn(a2a(q), a2a(k), a2a(v), causal=causal, scale=scale)
         return lax.all_to_all(
             out, axis_name=axis, split_axis=1, concat_axis=2, tiled=True
         )
 
     spec = P(None, axis, None, None)
+    # injected kernels (pallas interpret mode especially) trip jax's strict
+    # varying-axes checker inside shard_map — a jax-side limitation its own
+    # error message says to work around this way; the default dense path
+    # keeps full checking
+    sm_kwargs = {} if attn_fn is None else {"check_vma": False}
     return shard_map(
-        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        **sm_kwargs,
     )(q, k, v)

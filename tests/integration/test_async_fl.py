@@ -1,0 +1,232 @@
+"""Asynchronous (FedBuff) aggregation over the real cycle protocol:
+workers report whenever they finish, the node folds each report into a
+staleness-weighted buffer, and every ``buffer_size`` reports flush into a
+checkpoint — stale keys from flushed cycles re-home to the current
+buffer with weight (1+s)^-p.
+
+No reference analog (the reference is strictly synchronous —
+cycle_manager.py:180-217 readiness); FedBuff per Nguyen et al.,
+AISTATS '22."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from pygrid_tpu.client import FLClient, ModelCentricFLClient
+from pygrid_tpu.federated.cycle_manager import staleness_weight
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.plans.state import serialize_model_params
+
+from .conftest import ServerThread, _free_port
+
+D, H, C, B = 12, 6, 3, 4
+NAME, VERSION = "async-fl", "1.0"
+
+
+@pytest.fixture(scope="module")
+def node():
+    from pygrid_tpu.federated import tasks
+    from pygrid_tpu.node import create_app
+
+    prev = tasks._sync
+    tasks.set_sync(True)
+    server = ServerThread(create_app("async-node"), _free_port()).start()
+    yield server
+    tasks.set_sync(prev)
+    server.stop()
+
+
+def _host(node, name: str, **async_overrides):
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(5), (D, H, C))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(node.url)
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": name, "version": VERSION,
+            "batch_size": B, "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": 1, "max_workers": 8,
+            "num_cycles": 3,
+            "do_not_reuse_workers_until_cycle": 0,
+            "pool_selection": "random",
+            "async_aggregation": {
+                "buffer_size": 2, "staleness_power": 0.5, **async_overrides,
+            },
+        },
+    )
+    assert resp.get("status") == "success", resp
+    mc.close()
+    return params
+
+
+def _join(node):
+    client = FLClient(node.url, timeout=30.0)
+    wid = client.authenticate(NAME, VERSION)["worker_id"]
+    cyc = client.cycle_request(
+        wid, NAME, VERSION, ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cyc.get("status") == "accepted", cyc
+    return client, wid, cyc
+
+
+def _diff(seed: int, params) -> list[np.ndarray]:
+    rng = np.random.default_rng(seed)
+    return [rng.normal(0, 0.01, p.shape).astype(np.float32) for p in params]
+
+
+def test_fedbuff_staleness_weighted_flushes(node):
+    params = _host(node, NAME)
+    # three workers all download checkpoint 1
+    (ca, wa, cyca) = _join(node)
+    (cb, wb, cycb) = _join(node)
+    (cc, wc, cycc) = _join(node)
+    d_a, d_b, d_c = _diff(1, params), _diff(2, params), _diff(3, params)
+
+    # B and C fill buffer #1 (weights 1, 1) -> checkpoint 2
+    cb.report(wb, cycb["request_key"], serialize_model_params(d_b))
+    out = cc.report(wc, cycc["request_key"], serialize_model_params(d_c))
+    assert "error" not in out, out
+
+    mc = ModelCentricFLClient(node.url)
+    ckpt2 = mc.retrieve_model(NAME, VERSION)
+    expect2 = [
+        p - (db + dc) / 2.0 for p, db, dc in zip(params, d_b, d_c)
+    ]
+    for got, want in zip(ckpt2, expect2):
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+    # A's key was minted in the flushed cycle: its report re-homes to the
+    # current buffer with staleness 1 -> weight 2^-0.5
+    out = ca.report(wa, cyca["request_key"], serialize_model_params(d_a))
+    assert "error" not in out, out
+
+    # a fresh worker D (downloads checkpoint 2, weight 1) completes buffer
+    (cd, wd, cycd) = _join(node)
+    d_d = _diff(4, params)
+    out = cd.report(wd, cycd["request_key"], serialize_model_params(d_d))
+    assert "error" not in out, out
+
+    w_a = staleness_weight(1, 0.5)
+    expect3 = [
+        p2 - (w_a * da + dd) / (w_a + 1.0)
+        for p2, da, dd in zip(expect2, d_a, d_d)
+    ]
+    ckpt3 = mc.retrieve_model(NAME, VERSION)
+    for got, want in zip(ckpt3, expect3):
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+    mc.close()
+
+    # async re-admission: B already reported, may rejoin immediately
+    cyc_again = cb.cycle_request(
+        wb, NAME, VERSION, ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cyc_again.get("status") == "accepted", cyc_again
+    # ...but an un-reported assignment still blocks a duplicate
+    cyc_dup = cb.cycle_request(
+        wb, NAME, VERSION, ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cyc_dup.get("status") == "rejected", cyc_dup
+
+    # double-reporting one key is rejected
+    out = cc.report(wc, cycc["request_key"], serialize_model_params(d_c))
+    assert "error" in out, out
+    for cl in (ca, cb, cc, cd):
+        cl.close()
+
+
+def test_async_open_key_blocks_readmission_across_flushes(node):
+    """A worker holding an un-reported key from a FLUSHED cycle must not
+    get a second key — stale keys stay reportable via re-homing, so two
+    live keys would double-weight one worker in a single buffer."""
+    name = "async-twokeys"
+    params = _host(node, name)
+
+    def join(name):
+        client = FLClient(node.url, timeout=30.0)
+        wid = client.authenticate(name, VERSION)["worker_id"]
+        cyc = client.cycle_request(
+            wid, name, VERSION, ping=1.0, download=1000.0, upload=1000.0
+        )
+        return client, wid, cyc
+
+    ca, wa, cyca = join(name)  # joins, never reports
+    assert cyca.get("status") == "accepted"
+    cb, wb, cycb = join(name)
+    cc, wc, cycc = join(name)
+    d_b, d_c = _diff(7, params), _diff(8, params)
+    cb.report(wb, cycb["request_key"], serialize_model_params(d_b))
+    cc.report(wc, cycc["request_key"], serialize_model_params(d_c))
+    # buffer flushed (cycle 1 closed); A's key is stale but still open
+    again = ca.cycle_request(
+        wa, name, VERSION, ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert again.get("status") == "rejected", again
+    # after A reports its stale key, re-admission opens
+    out = ca.report(wa, cyca["request_key"], serialize_model_params(d_b))
+    assert "error" not in out, out
+    again = ca.cycle_request(
+        wa, name, VERSION, ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert again.get("status") == "accepted", again
+    for cl in (ca, cb, cc):
+        cl.close()
+
+
+def test_async_host_rejects_bad_configs(node):
+    from pygrid_tpu.utils.exceptions import PyGridError
+
+    params = [np.zeros((4, 2), np.float32), np.zeros((2,), np.float32)]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, 4), np.float32),
+        np.zeros((B, 2), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(node.url)
+    base = {"min_workers": 1, "max_workers": 4, "num_cycles": 1}
+    for server_config in (
+        {**base, "async_aggregation": {"buffer_size": 0}},
+        {**base, "async_aggregation": {"buffer_size": 2,
+                                       "staleness_power": -1}},
+        {**base, "async_aggregation": "yes"},
+        {**base, "async_aggregation": {"buffer_size": 2},
+         "differential_privacy": {"clip_norm": 1.0}},
+        {**base, "async_aggregation": {"buffer_size": 2}, "min_diffs": 2,
+         "max_diffs": 2,
+         "secure_aggregation": {"clip_range": 1.0, "threshold": 2}},
+    ):
+        with pytest.raises(PyGridError):
+            mc.host_federated_training(
+                model=params,
+                client_plans={"training_plan": plan},
+                client_config={
+                    "name": "async-bad", "version": "1.0",
+                    "batch_size": B, "lr": 0.1, "max_updates": 1,
+                },
+                server_config=server_config,
+            )
+    mc.close()
+
+
+def test_staleness_weight_values():
+    assert staleness_weight(0) == 1.0
+    assert staleness_weight(1, 0.5) == pytest.approx(2 ** -0.5)
+    assert staleness_weight(3, 1.0) == pytest.approx(0.25)
+    assert staleness_weight(-2) == 1.0  # clamped
+    assert staleness_weight(5, 0.0) == 1.0  # p=0 disables discounting

@@ -50,6 +50,23 @@ def test_ulysses_rejects_indivisible_heads(mesh):
         ulysses_attention(q, k, v, mesh)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_with_flash_kernel(mesh, causal):
+    """The Pallas flash kernel as the per-head-group primitive inside the
+    all-to-all scheme (interpret mode on the CPU mesh)."""
+    from functools import partial
+
+    from pygrid_tpu.parallel.pallas_attention import flash_attention
+
+    q, k, v = _qkv()
+    ref = attention(q, k, v, causal=causal)
+    out = ulysses_attention(
+        q, k, v, mesh, causal=causal,
+        attn_fn=partial(flash_attention, interpret=True),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
 def test_ring_gradients_match_full(mesh):
     q, k, v = _qkv(B=1, L=16, H=2, D=4)
 
