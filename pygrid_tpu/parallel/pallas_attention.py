@@ -54,7 +54,7 @@ _NEG = -1e30
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
     *, scale, causal, lk_true, n_k, block_q, block_k, precision,
 ):
     qi = pl.program_id(1)
@@ -114,6 +114,14 @@ def _flash_kernel(
         o_ref[0] = (
             acc[:] / jnp.maximum(l_final, 1e-30)
         ).astype(o_ref.dtype)
+        # log-sum-exp per query row — the residual the backward pass
+        # needs to re-derive P = exp(s - lse) blockwise without ever
+        # materializing the full score tensor. 8 lanes per row, not a
+        # full 128-lane broadcast: Mosaic's block rule needs the minor
+        # dim ÷128 OR equal to the array's — 8 satisfies the latter at
+        # 1/16th the HBM write traffic
+        lse = m_scr[:][:, :1] + jnp.log(jnp.maximum(l_final, 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _pad_to(x: jax.Array, length: int, axis: int) -> jax.Array:
@@ -125,40 +133,12 @@ def _pad_to(x: jax.Array, length: int, axis: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "causal", "scale", "interpret", "block_q", "block_k", "precision"
-    ),
-)
-def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    causal: bool = False,
-    scale: float | None = None,
-    interpret: bool = False,
-    block_q: int = BLOCK_Q,
-    block_k: int = BLOCK_K,
-    precision: lax.Precision | None = None,
-) -> jax.Array:
-    """Fused attention, [B, L, H, D] (the layout `attention` uses).
-
-    Any (Lq, Lk, D): inputs are zero-padded to tile multiples and pad
-    keys masked by position. ``causal`` requires Lq == Lk (self-attention
-    alignment). ``interpret=True`` runs the kernel on CPU for tests.
-
-    ``precision`` reaches both MXU dots: the default (None) feeds the MXU
-    bf16 operands with f32 accumulation — the standard TPU trade, and
-    what f32 inputs get from plain XLA too; pass
-    ``lax.Precision.HIGHEST`` for full-f32 operand passes when attention
-    scores must match a float32 reference bit-closely.
-    """
+def _fwd_impl(
+    q, k, v, causal, scale, interpret, block_q, block_k, precision
+):
+    """Run the kernel; returns (out [B,Lq,H,D], lse [B·H,Lq] f32)."""
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    if causal and Lq != Lk:
-        raise ValueError("causal flash_attention requires Lq == Lk")
-    scale_ = scale if scale is not None else D**-0.5
 
     # [B, L, H, D] → [B·H, L, D]
     def to_bhld(x):
@@ -188,24 +168,33 @@ def flash_attention(
         (1, block_q, Dp), lambda bh, qi, ki: (bh, qi, 0),
         memory_space=pltpu.VMEM,
     )
-    # under shard_map the output inherits the inputs' varying mesh axes —
+    lse_spec = pl.BlockSpec(
+        (1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+    # under shard_map the outputs inherit the inputs' varying mesh axes —
     # the vma must be declared on the out_shape or check_vma rejects it
     vma = getattr(jax.typeof(qf), "vma", None)
-    out_struct = (
-        jax.ShapeDtypeStruct((B * H, Lqp, Dp), q.dtype, vma=vma)
-        if vma
-        else jax.ShapeDtypeStruct((B * H, Lqp, Dp), q.dtype)
-    )
-    out = pl.pallas_call(
+
+    def struct(shape, dtype):
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    out, lse = pl.pallas_call(
         partial(
             _flash_kernel,
-            scale=scale_, causal=causal, lk_true=Lk, n_k=n_k,
+            scale=scale, causal=causal, lk_true=Lk, n_k=n_k,
             block_q=block_q, block_k=block_k, precision=precision,
         ),
         grid=(B * H, Lqp // block_q, n_k),
         in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=o_spec,
-        out_shape=out_struct,
+        out_specs=[o_spec, lse_spec],
+        out_shape=[
+            struct((B * H, Lqp, Dp), q.dtype),
+            struct((B * H, Lqp, 8), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, Dp), jnp.float32),
             pltpu.VMEM((block_q, MIN_D), jnp.float32),
@@ -217,8 +206,143 @@ def flash_attention(
         interpret=interpret,
     )(qf, kf, vf)
     # [B·H, Lqp, Dp] → [B, Lq, H, D]
+    out = out[:, :Lq, :D].reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+    return out, lse[:, :Lq, 0]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, interpret, block_q, block_k, precision):
+    out, _ = _fwd_impl(
+        q, k, v, causal, scale, interpret, block_q, block_k, precision
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret, block_q, block_k, precision):
+    out, lse = _fwd_impl(
+        q, k, v, causal, scale, interpret, block_q, block_k, precision
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(
+    causal, scale, interpret, block_q, block_k, precision, residuals, do
+):
+    """Flash backward (Dao et al. §3.1), a ``lax.scan`` over key blocks in
+    plain XLA: with the forward's per-row log-sum-exp saved,
+    P = exp(s − lse) re-derives exactly per block, so memory stays
+    O(L·block) and — because the loop is a scan, not a trace-time unroll
+    — compile time stays O(1) in sequence length. Under causal masking
+    the scan computes full-Lq blocks and masks (scan bodies need static
+    shapes, so the forward's upper-triangle block skip cannot carry over)
+    — ~2× extra MXU work on causal backward, traded for O(1) compilation
+    at the long contexts this path exists for."""
+    q, k, v, o, lse = residuals
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    in_dtypes = (q.dtype, k.dtype, v.dtype)
+
+    def to_bhld(x):
+        return (
+            x.transpose(0, 2, 1, 3)
+            .reshape(B * H, x.shape[1], D)
+            .astype(jnp.float32)
+        )
+
+    qf, kf, vf, of, dof = map(to_bhld, (q, k, v, o, do))
+    # D_i = rowsum(dO ∘ O) — the softmax-jacobian diagonal term
+    delta = jnp.sum(dof * of, axis=-1)  # [BH, Lq]
+
+    bk = min(block_k, pl.cdiv(Lk, 128) * 128)
+    Lkp = pl.cdiv(Lk, bk) * bk
+    n_blocks = Lkp // bk
+    kf = _pad_to(kf, Lkp, 1)
+    vf = _pad_to(vf, Lkp, 1)
+    # [n_blocks, BH, bk, D] so the scan consumes one block per step
+    k_blocks = kf.reshape(kf.shape[0], n_blocks, bk, D).transpose(1, 0, 2, 3)
+    v_blocks = vf.reshape(vf.shape[0], n_blocks, bk, D).transpose(1, 0, 2, 3)
+    q_pos = jnp.arange(Lq)
+
+    def body(dq, blk):
+        bi, k_blk, v_blk = blk
+        s = jnp.einsum(
+            "nqd,nkd->nqk", qf, k_blk, precision=precision
+        ) * scale
+        k_pos = bi * bk + jnp.arange(bk)
+        valid = (k_pos < Lk)[None, :]  # pad keys contribute nothing
+        if causal:
+            valid = jnp.logical_and(valid, q_pos[:, None] >= k_pos[None, :])
+        p = jnp.where(valid[None], jnp.exp(s - lse[:, :, None]), 0.0)
+        dv_blk = jnp.einsum("nqk,nqd->nkd", p, dof, precision=precision)
+        dp = jnp.einsum("nqd,nkd->nqk", dof, v_blk, precision=precision)
+        ds = p * (dp - delta[:, :, None]) * scale
+        dq = dq + jnp.einsum(
+            "nqk,nkd->nqd", ds, k_blk, precision=precision
+        )
+        dk_blk = jnp.einsum("nqk,nqd->nkd", ds, qf, precision=precision)
+        return dq, (dk_blk, dv_blk)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        body,
+        jnp.zeros_like(qf),
+        (jnp.arange(n_blocks), k_blocks, v_blocks),
+    )
+    # [n_blocks, BH, bk, D] → [BH, Lk, D]
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(-1, Lkp, D)[:, :Lk]
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(-1, Lkp, D)[:, :Lk]
+
+    def back(x, dtype):
+        return (
+            x.reshape(B, H, -1, D).transpose(0, 2, 1, 3).astype(dtype)
+        )
+
     return (
-        out[:, :Lq, :D]
-        .reshape(B, H, Lq, D)
-        .transpose(0, 2, 1, 3)
+        back(dq, in_dtypes[0]), back(dk, in_dtypes[1]), back(dv, in_dtypes[2])
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "scale", "interpret", "block_q", "block_k", "precision"
+    ),
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    interpret: bool = False,
+    block_q: int = BLOCK_Q,
+    block_k: int = BLOCK_K,
+    precision: lax.Precision | None = None,
+) -> jax.Array:
+    """Fused attention, [B, L, H, D] (the layout `attention` uses).
+
+    Any (Lq, Lk, D): inputs are zero-padded to tile multiples and pad
+    keys masked by position. ``causal`` requires Lq == Lk (self-attention
+    alignment). ``interpret=True`` runs the kernel on CPU for tests.
+
+    Differentiable: the forward kernel saves each query row's
+    log-sum-exp, and a custom VJP runs the flash backward blocked over
+    key blocks — O(L·block) memory in both directions, so long-context
+    TRAINING fits where the XLA path cannot even materialize the scores.
+
+    ``precision`` reaches both MXU dots: the default (None) feeds the MXU
+    bf16 operands with f32 accumulation — the standard TPU trade, and
+    what f32 inputs get from plain XLA too; pass
+    ``lax.Precision.HIGHEST`` for full-f32 operand passes when attention
+    scores must match a float32 reference bit-closely.
+    """
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if causal and Lq != Lk:
+        raise ValueError("causal flash_attention requires Lq == Lk")
+    scale_ = scale if scale is not None else D**-0.5
+    return _flash(
+        q, k, v, causal, scale_, interpret, block_q, block_k, precision
     )
