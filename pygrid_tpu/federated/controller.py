@@ -110,6 +110,23 @@ class FLController:
                     "individually visible reports)"
                 )
 
+        from pygrid_tpu.federated import robust
+
+        robust.validate_config(server_config)
+        if server_config.get("robust_aggregation") is not None:
+            if server_averaging_plan is not None:
+                raise E.PyGridError(
+                    "robust_aggregation replaces the averaging step — a "
+                    "custom averaging plan cannot run alongside it"
+                )
+            if (client_config or {}).get("diff_compression"):
+                raise E.PyGridError(
+                    "robust_aggregation is incompatible with "
+                    "diff_compression (top-k sparse diffs are mostly zeros "
+                    "after densify, so coordinate order statistics collapse "
+                    "toward zero)"
+                )
+
         from pygrid_tpu.federated.secagg_service import SecAggService
 
         SecAggService.validate_host_config(server_config)

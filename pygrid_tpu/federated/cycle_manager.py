@@ -101,6 +101,7 @@ class CycleManager:
         self._accum_lock = threading.Lock()
         self._dp_cache: dict[int, dict | None] = {}
         self._async_cache: dict[int, dict | None] = {}
+        self._robust_cache: dict[int, dict | None] = {}
         # the FedBuff buffer is PROCESS-scoped, not cycle-scoped: an ingest
         # racing a flush then lands either before the pop (flushed now) or
         # after (first entry of the next buffer) — no orphaned cycle-keyed
@@ -312,10 +313,14 @@ class CycleManager:
                 "diff": diff,
             },
         )
-        if self._uses_fallback_mean(cycle.fl_process_id):
+        if self._uses_fallback_mean(cycle.fl_process_id) and (
+            self._robust_config(cycle.fl_process_id) is None
+        ):
             # fold into the running sum now — aggregation work rides each
             # report instead of spiking at cycle completion (the blob is
             # still stored above: parity surface + restart recovery).
+            # Robust (order-statistic) processes skip this: median/trimmed
+            # mean need every diff separately at completion.
             # Decode happened outside the lock: only the cheap fold
             # serializes.
             dp = self._dp_config(cycle.fl_process_id)
@@ -403,6 +408,21 @@ class CycleManager:
                 raise E.PyGridError("async_aggregation must be a dict")
             cached = raw or None
             self._async_cache[fl_process_id] = cached
+        return cached
+
+    def _robust_config(self, fl_process_id: int) -> dict | None:
+        """The process's robust_aggregation server_config (cached —
+        immutable after hosting)."""
+        cached = self._robust_cache.get(fl_process_id, _UNSET)
+        if cached is _UNSET:
+            server_config = self.process_manager.get_configs(
+                fl_process_id=fl_process_id, is_server_config=True
+            )
+            raw = server_config.get("robust_aggregation")
+            if raw is not None and not isinstance(raw, dict):
+                raise E.PyGridError("robust_aggregation must be a dict")
+            cached = raw or None
+            self._robust_cache[fl_process_id] = cached
         return cached
 
     def _model_shapes(self, fl_process_id: int) -> list[tuple]:
@@ -591,7 +611,19 @@ class CycleManager:
                 return decoded
 
             n_diffs = 0
-            if avg_plan_rec is not None and avg_plan_rec.value_xla:
+            robust_cfg = self._robust_config(process.id)
+            if robust_cfg is not None:
+                # order statistics need every diff separately — aggregate
+                # from the stored rows (DP/secagg/async/avg-plan combos
+                # are rejected at host time)
+                from pygrid_tpu.federated.robust import robust_aggregate
+
+                diff_params = [
+                    decode_diff(d) for d in self._received_diffs(cycle.id)
+                ]
+                n_diffs = len(diff_params)
+                avg_diff = robust_aggregate(diff_params, robust_cfg)
+            elif avg_plan_rec is not None and avg_plan_rec.value_xla:
                 diff_params = [
                     _decode(d) for d in self._received_diffs(cycle.id)
                 ]

@@ -76,3 +76,9 @@ def test_secagg_fl_example():
     result = _run("secagg_fl.py", "--spawn")
     assert result.returncode == 0, result.stderr
     assert "secure aggregation OK" in result.stdout
+
+
+def test_async_fl_example():
+    result = _run("async_fl.py", "--spawn")
+    assert result.returncode == 0, result.stderr
+    assert "async FL OK" in result.stdout

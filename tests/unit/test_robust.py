@@ -1,0 +1,186 @@
+"""Byzantine-robust aggregation (federated/robust.py): coordinate
+median and trimmed mean per Yin et al. ICML '18, plus the protocol
+integration — a malicious worker's arbitrary diff must not move the
+checkpoint. No reference analog (plain mean only there,
+cycle_manager.py:275-290)."""
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.federated.robust import (
+    coordinate_median,
+    robust_aggregate,
+    trimmed_mean,
+    validate_config,
+)
+from pygrid_tpu.utils.exceptions import PyGridError
+
+
+def _diffs(values):
+    return [[np.asarray(v, dtype=np.float32)] for v in values]
+
+
+def test_median_ignores_one_outlier():
+    diffs = _diffs([[1.0, 2.0], [1.1, 2.1], [1e9, -1e9]])
+    out = coordinate_median(diffs)
+    # per coordinate: median(1.0, 1.1, 1e9)=1.1; median(2.0, 2.1, -1e9)=2.0
+    np.testing.assert_allclose(out[0], [1.1, 2.0])
+
+
+def test_median_tolerates_minority_byzantine():
+    honest = [[1.0]] * 3
+    byzantine = [[1e12]] * 2  # 2 of 5 arbitrary
+    out = coordinate_median(_diffs(honest + byzantine))
+    np.testing.assert_allclose(out[0], [1.0])
+
+
+def test_trimmed_mean_drops_tails():
+    diffs = _diffs([[0.0], [1.0], [2.0], [3.0], [100.0]])
+    # ceil(0.2·5)=1 from each tail → mean of [1, 2, 3]
+    out = trimmed_mean(diffs, trim_fraction=0.2)
+    np.testing.assert_allclose(out[0], [2.0])
+
+
+def test_trimmed_mean_zero_trim_is_plain_mean():
+    diffs = _diffs([[1.0], [2.0], [6.0]])
+    out = trimmed_mean(diffs, trim_fraction=0.0)
+    np.testing.assert_allclose(out[0], [3.0])
+
+
+def test_trimmed_mean_rejects_overtrim():
+    with pytest.raises(PyGridError, match="trims everything"):
+        trimmed_mean(_diffs([[1.0], [2.0]]), trim_fraction=0.4)
+    with pytest.raises(PyGridError):
+        trimmed_mean(_diffs([[1.0]]), trim_fraction=0.6)
+
+
+def test_multi_tensor_shapes_preserved():
+    k = 5
+    rng = np.random.default_rng(0)
+    diffs = [
+        [rng.normal(size=(3, 2)).astype(np.float32),
+         rng.normal(size=(4,)).astype(np.float32)]
+        for _ in range(k)
+    ]
+    for out in (
+        coordinate_median(diffs),
+        trimmed_mean(diffs, 0.2),
+        robust_aggregate(diffs, {"name": "median"}),
+    ):
+        assert out[0].shape == (3, 2) and out[1].shape == (4,)
+        assert out[0].dtype == np.float32
+
+
+def test_validate_config():
+    validate_config({})
+    validate_config({"robust_aggregation": {"name": "median"}})
+    validate_config(
+        {"robust_aggregation": {"name": "trimmed_mean",
+                                "trim_fraction": 0.2},
+         "min_diffs": 5}
+    )
+    for bad in (
+        {"robust_aggregation": "median"},
+        {"robust_aggregation": {"name": "krum"}},
+        {"robust_aggregation": {"name": "trimmed_mean",
+                                "trim_fraction": 0.5}, "min_diffs": 10},
+        # no min_diffs: one report would complete the cycle, trim empty
+        {"robust_aggregation": {"name": "trimmed_mean"}},
+        # trims everything at the minimum completion count
+        {"robust_aggregation": {"name": "trimmed_mean",
+                                "trim_fraction": 0.3}, "min_diffs": 2},
+        {"robust_aggregation": {"name": "median"},
+         "differential_privacy": {"clip_norm": 1.0}},
+        {"robust_aggregation": {"name": "median"},
+         "async_aggregation": {"buffer_size": 2}},
+        {"robust_aggregation": {"name": "median"},
+         "secure_aggregation": {"clip_range": 1.0}},
+    ):
+        with pytest.raises(PyGridError):
+            validate_config(bad)
+
+
+def test_robust_aggregate_degrades_to_median_when_trim_impossible():
+    """An untrimmable count at completion must aggregate (median), not
+    raise — an exception would wedge the cycle forever."""
+    diffs = _diffs([[1.0], [100.0]])  # k=2, cut=1 -> nothing left
+    out = robust_aggregate(
+        diffs, {"name": "trimmed_mean", "trim_fraction": 0.3}
+    )
+    np.testing.assert_allclose(out[0], [50.5])  # median of 2 = midpoint
+
+
+def test_protocol_median_survives_byzantine_worker():
+    """Full cycle over the node events: 4 workers report, one sends a
+    garbage diff scaled 1e6 — the median checkpoint matches the honest
+    workers' median exactly; the plain mean would have been destroyed."""
+    import jax
+
+    from pygrid_tpu.federated import FLController, tasks
+    from pygrid_tpu.models import mlp
+    from pygrid_tpu.plans.plan import Plan
+    from pygrid_tpu.plans.state import (
+        serialize_model_params,
+        unserialize_model_params,
+    )
+    from pygrid_tpu.storage import Database
+
+    tasks.set_sync(True)
+    D_, H_, C_, B_ = 8, 4, 2, 4
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(0), (D_, H_, C_))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B_, D_), np.float32),
+        np.zeros((B_, C_), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    fl = FLController(Database(":memory:"))
+    fl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": plan},
+        name="robust", version="1.0",
+        client_config={"name": "robust", "version": "1.0",
+                       "batch_size": B_, "lr": 0.1, "max_updates": 1},
+        server_config={
+            "min_workers": 4, "max_workers": 4,
+            "min_diffs": 4, "max_diffs": 4, "num_cycles": 1,
+            "robust_aggregation": {"name": "median"},
+        },
+    )
+    rng = np.random.default_rng(1)
+    honest = [
+        [rng.normal(0, 0.01, p.shape).astype(np.float32) for p in params]
+        for _ in range(3)
+    ]
+    byzantine = [np.full(p.shape, 1e6, np.float32) for p in params]
+    keys = []
+    for i in range(4):
+        worker = fl.worker_manager.create(f"w{i}")
+        resp = fl.assign("robust", "1.0", worker)
+        assert resp["status"] == "accepted", resp
+        keys.append(resp["request_key"])
+    for i, diff in enumerate(honest):
+        fl.submit_diff(f"w{i}", keys[i], serialize_model_params(diff))
+    fl.submit_diff("w3", keys[3], serialize_model_params(byzantine))
+
+    model = fl.model_manager.get(fl_process_id=1)
+    latest = fl.model_manager.load(model_id=model.id, alias="latest")
+    new_params = unserialize_model_params(latest.value)
+    stacked = [
+        np.stack([h[k] for h in honest] + [byzantine[k]])
+        for k in range(len(params))
+    ]
+    expected = [
+        p - np.median(s, axis=0) for p, s in zip(params, stacked)
+    ]
+    for got, want in zip(new_params, expected):
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+    # the median is within the honest envelope — the attacker moved nothing
+    for k, s in enumerate(stacked):
+        med = np.median(s, axis=0)
+        honest_only = s[:3]
+        assert (med <= honest_only.max(0) + 1e-9).all()
+        assert (med >= honest_only.min(0) - 1e-9).all()
