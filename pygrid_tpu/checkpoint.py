@@ -1,0 +1,54 @@
+"""Orbax checkpoint interop — export/import FL model checkpoints in the
+JAX ecosystem's standard on-disk format.
+
+The grid's own persistence is the wire format (`plans/state.py` States in
+sqlite rows — the reference's protobuf-State analog, model_manager.py:80-103);
+this module bridges to `orbax.checkpoint` so models trained on the grid
+drop straight into the wider JAX toolchain (and vice versa: any
+orbax-saved list-of-arrays pytree can be hosted as an FL process).
+
+    from pygrid_tpu.checkpoint import export_checkpoint, import_checkpoint
+    export_checkpoint(client.retrieve_model("mnist", "1.0"), "/ckpts/mnist")
+    params = import_checkpoint("/ckpts/mnist")
+
+No reference analog: the reference's only export is its protobuf wire
+blobs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+from pygrid_tpu.utils.exceptions import PyGridError
+
+
+def export_checkpoint(params: Sequence, path: str | os.PathLike) -> None:
+    """Save a parameter list (any list of arrays — the shape
+    ``retrieve_model``/``unserialize_model_params`` return) as an orbax
+    StandardCheckpoint directory at ``path`` (must not exist)."""
+    import orbax.checkpoint as ocp
+
+    arrays = [np.asarray(p) for p in params]
+    if not arrays:
+        raise PyGridError("nothing to export")
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(os.path.abspath(os.fspath(path)), arrays)
+    checkpointer.wait_until_finished()
+
+
+def import_checkpoint(path: str | os.PathLike) -> list[np.ndarray]:
+    """Load an orbax StandardCheckpoint directory back into the list-of-
+    arrays shape every hosting/serving API takes."""
+    import orbax.checkpoint as ocp
+
+    checkpointer = ocp.StandardCheckpointer()
+    restored = checkpointer.restore(os.path.abspath(os.fspath(path)))
+    if not isinstance(restored, (list, tuple)):
+        raise PyGridError(
+            "checkpoint is not a list-of-arrays pytree; re-export it as a "
+            "flat parameter list"
+        )
+    return [np.asarray(p) for p in restored]

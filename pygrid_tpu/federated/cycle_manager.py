@@ -396,34 +396,14 @@ class CycleManager:
         )
 
     def _async_config(self, fl_process_id: int) -> dict | None:
-        """The process's async_aggregation (FedBuff) server_config (cached
-        — immutable after hosting)."""
-        cached = self._async_cache.get(fl_process_id, _UNSET)
-        if cached is _UNSET:
-            server_config = self.process_manager.get_configs(
-                fl_process_id=fl_process_id, is_server_config=True
-            )
-            raw = server_config.get("async_aggregation")
-            if raw is not None and not isinstance(raw, dict):
-                raise E.PyGridError("async_aggregation must be a dict")
-            cached = raw or None
-            self._async_cache[fl_process_id] = cached
-        return cached
+        return self._cached_server_section(
+            self._async_cache, fl_process_id, "async_aggregation"
+        )
 
     def _robust_config(self, fl_process_id: int) -> dict | None:
-        """The process's robust_aggregation server_config (cached —
-        immutable after hosting)."""
-        cached = self._robust_cache.get(fl_process_id, _UNSET)
-        if cached is _UNSET:
-            server_config = self.process_manager.get_configs(
-                fl_process_id=fl_process_id, is_server_config=True
-            )
-            raw = server_config.get("robust_aggregation")
-            if raw is not None and not isinstance(raw, dict):
-                raise E.PyGridError("robust_aggregation must be a dict")
-            cached = raw or None
-            self._robust_cache[fl_process_id] = cached
-        return cached
+        return self._cached_server_section(
+            self._robust_cache, fl_process_id, "robust_aggregation"
+        )
 
     def _model_shapes(self, fl_process_id: int) -> list[tuple]:
         """Expected diff tensor shapes — the model's parameter shapes, fixed
@@ -440,23 +420,31 @@ class CycleManager:
             self._shape_cache[fl_process_id] = cached
         return cached
 
-    def _dp_config(self, fl_process_id: int) -> dict | None:
-        """The process's differential_privacy config (cached — immutable
-        after hosting, and the report path must not re-query per diff)."""
-        cached = self._dp_cache.get(fl_process_id, _UNSET)
+    def _cached_server_section(
+        self, cache: dict, fl_process_id: int, key: str
+    ) -> dict | None:
+        """One cached accessor for the optional server_config sections the
+        hot paths branch on (DP / async / robust) — immutable after
+        hosting, so the report path never re-queries per diff. A non-dict
+        value fails typed BEFORE any falsy coercion (a hand-edited DB row
+        must not silently disable a privacy/robustness feature); {} means
+        unset."""
+        cached = cache.get(fl_process_id, _UNSET)
         if cached is _UNSET:
             server_config = self.process_manager.get_configs(
                 fl_process_id=fl_process_id, is_server_config=True
             )
-            raw = server_config.get("differential_privacy")
+            raw = server_config.get(key)
             if raw is not None and not isinstance(raw, dict):
-                # hosting validates this; a hand-edited DB row must still
-                # fail typed — BEFORE any falsy coercion, or [] / 0 / ""
-                # would silently disable DP instead of erroring
-                raise E.PyGridError("differential_privacy must be a dict")
-            cached = raw or None  # {} means unset
-            self._dp_cache[fl_process_id] = cached
+                raise E.PyGridError(f"{key} must be a dict")
+            cached = raw or None
+            cache[fl_process_id] = cached
         return cached
+
+    def _dp_config(self, fl_process_id: int) -> dict | None:
+        return self._cached_server_section(
+            self._dp_cache, fl_process_id, "differential_privacy"
+        )
 
     def _uses_fallback_mean(self, fl_process_id: int) -> bool:
         """True when no hosted averaging plan will run (the hardcoded-FedAvg
@@ -614,12 +602,15 @@ class CycleManager:
             robust_cfg = self._robust_config(process.id)
             if robust_cfg is not None:
                 # order statistics need every diff separately — aggregate
-                # from the stored rows (DP/secagg/async/avg-plan combos
-                # are rejected at host time)
+                # from the stored rows. _decode (not raw decode_diff) so
+                # this door stays on the one validated decode path: today
+                # dp is None here (robust+DP rejected at host time), but
+                # if that rule ever relaxes the re-clip must not silently
+                # vanish
                 from pygrid_tpu.federated.robust import robust_aggregate
 
                 diff_params = [
-                    decode_diff(d) for d in self._received_diffs(cycle.id)
+                    _decode(d) for d in self._received_diffs(cycle.id)
                 ]
                 n_diffs = len(diff_params)
                 avg_diff = robust_aggregate(diff_params, robust_cfg)
