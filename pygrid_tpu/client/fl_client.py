@@ -295,6 +295,32 @@ class FLClient:
         self._plan_cache[(plan_id, receive_operations_as)] = plan
         return plan
 
+    def report_metrics(
+        self,
+        worker_id: str,
+        request_key: str,
+        loss: float | None = None,
+        acc: float | None = None,
+        n_samples: int = 1,
+    ) -> dict:
+        """Attach local training metrics to this assignment — the node
+        aggregates them sample-weighted per cycle (GET
+        /model-centric/cycle-metrics). Accepted after the cycle closes."""
+        metrics: dict = {"n_samples": n_samples}
+        if loss is not None:
+            metrics["loss"] = float(loss)
+        if acc is not None:
+            metrics["acc"] = float(acc)
+        response = self._send_event(
+            MODEL_CENTRIC_FL_EVENTS.REPORT_METRICS,
+            data={
+                MSG_FIELD.WORKER_ID: worker_id,
+                CYCLE.KEY: request_key,
+                "metrics": metrics,
+            },
+        )
+        return response.get(MSG_FIELD.DATA, response)
+
     def report(self, worker_id: str, request_key: str, diff_blob: bytes) -> dict:
         diff: Any = (
             diff_blob

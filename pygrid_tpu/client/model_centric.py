@@ -92,5 +92,22 @@ class ModelCentricFLClient:
             raise PyGridError(resp.text)
         return unserialize_model_params(resp.content)
 
+    def cycle_metrics(
+        self, name: str, version: str | None = None
+    ) -> list[dict]:
+        """Per-cycle sample-weighted training metrics the fleet reported
+        (loss/acc/report counts) — the training curve without any raw
+        data leaving workers."""
+        params: dict[str, Any] = {"name": name}
+        if version is not None:
+            params["version"] = version
+        resp = requests.get(
+            f"{self.address}/model-centric/cycle-metrics", params=params,
+            timeout=30,
+        )
+        if resp.status_code != 200:
+            raise PyGridError(resp.text)
+        return resp.json()["cycles"]
+
     def close(self) -> None:
         self.ws.close()

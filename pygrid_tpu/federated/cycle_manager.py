@@ -343,6 +343,83 @@ class CycleManager:
                     self._accum.pop(cycle.id, None)
         tasks.run_task_once(f"complete_cycle_{cycle.id}", self.complete_cycle, cycle.id)
 
+    #: self-reported metric bounds: values are observability telemetry,
+    #: not trusted statistics — the caps bound any single worker's
+    #: influence on the aggregate curve (they cannot make it trustworthy
+    #: against coordinated liars; nothing can, metrics are self-reported)
+    METRIC_VALUE_BOUND = 1e6
+    METRIC_MAX_SAMPLES = 10**6
+
+    def submit_worker_metrics(
+        self, worker_id: str, request_key: str, metrics: dict
+    ) -> None:
+        """Attach client-reported training metrics ({loss, acc,
+        n_samples}) to the worker's assignment row. Accepted even after
+        the cycle flushed (metrics often trail the diff); validated and
+        bounded. Refused for privacy-configured processes: a per-client
+        loss is a membership-inference signal, and storing it in the
+        clear would void exactly what DP noise / SecAgg masking paid
+        for."""
+        cycle, wc = self.resolve_worker_cycle(
+            worker_id, request_key, include_completed=True
+        )
+        pid = cycle.fl_process_id
+        if (
+            self._dp_config(pid) is not None
+            or self.secagg.config_for(pid) is not None
+        ):
+            raise E.PyGridError(
+                "per-client metrics are not stored for processes with "
+                "differential_privacy or secure_aggregation (individual "
+                "training loss is a membership-inference signal)"
+            )
+        clean: dict[str, float] = {}
+        for key in ("loss", "acc"):
+            if key in metrics:
+                value = float(metrics[key])
+                if not np.isfinite(value) or abs(value) > self.METRIC_VALUE_BOUND:
+                    raise E.PyGridError(f"metric {key} out of bounds")
+                clean[key] = value
+        n = int(metrics.get("n_samples", 1))
+        if not 1 <= n <= self.METRIC_MAX_SAMPLES:
+            raise E.PyGridError("n_samples out of range")
+        clean["n_samples"] = n
+        if not set(clean) - {"n_samples"}:
+            raise E.PyGridError("metrics must include loss and/or acc")
+        from pygrid_tpu.serde import serialize
+
+        self._worker_cycles.modify({"id": wc.id}, {"metrics": serialize(clean)})
+
+    def cycle_metrics(self, fl_process_id: int) -> list[dict]:
+        """Per-cycle sample-weighted aggregation of reported metrics —
+        the fleet's training curve without any raw data leaving workers."""
+        from pygrid_tpu.serde import deserialize
+
+        out = []
+        for cycle in self._cycles.query(fl_process_id=fl_process_id):
+            totals: dict[str, float] = {}
+            weights: dict[str, float] = {}
+            n_reports = 0
+            for wc in self._worker_cycles.query(cycle_id=cycle.id):
+                if not wc.metrics:
+                    continue
+                m = deserialize(wc.metrics)
+                n = float(m.get("n_samples", 1))
+                n_reports += 1
+                for key in ("loss", "acc"):
+                    if key in m:
+                        totals[key] = totals.get(key, 0.0) + m[key] * n
+                        weights[key] = weights.get(key, 0.0) + n
+            entry: dict = {
+                "cycle": cycle.sequence,
+                "completed": bool(cycle.is_completed),
+                "reports": n_reports,
+            }
+            for key, total in totals.items():
+                entry[key] = total / weights[key]
+            out.append(entry)
+        return sorted(out, key=lambda e: e["cycle"])
+
     def _decode_and_check(self, diff: bytes, fl_process_id: int) -> list:
         """The one report-validation door (sync + async): non-empty,
         decodable, shapes match the hosted model — a bad blob bounces to

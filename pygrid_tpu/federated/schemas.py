@@ -55,6 +55,10 @@ class WorkerCycle:
     #: (FedBuff) aggregation weights its eventual report by how many
     #: checkpoints landed in between (staleness); 0 for sync processes
     assigned_checkpoint: int = 0
+    #: optional client-reported training metrics (serialized
+    #: {loss, acc, n_samples}) — aggregated sample-weighted per cycle by
+    #: /model-centric/cycle-metrics; never part of the aggregation math
+    metrics: bytes | None = None
 
 
 @dataclass

@@ -221,6 +221,28 @@ def report(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     }
 
 
+def report_metrics(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """Client-reported training metrics for an assignment (this
+    framework's extension — the reference has no structured metrics,
+    SURVEY §5.5). Sample-weighted per-cycle aggregation is served by
+    GET /model-centric/cycle-metrics."""
+    data = message.get(MSG_FIELD.DATA) or {}
+    response: dict[str, Any] = {}
+    try:
+        ctx.fl.cycle_manager.submit_worker_metrics(
+            data.get(MSG_FIELD.WORKER_ID),
+            data.get(CYCLE.KEY),
+            data.get("metrics") or {},
+        )
+        response[CYCLE.STATUS] = SUCCESS
+    except Exception as err:  # noqa: BLE001 — protocol boundary
+        response[ERROR] = str(err)
+    return {
+        MSG_FIELD.TYPE: MODEL_CENTRIC_FL_EVENTS.REPORT_METRICS,
+        MSG_FIELD.DATA: response,
+    }
+
+
 # ── secure-aggregation rounds (this framework's extension; secagg_service) ───
 
 
@@ -437,6 +459,7 @@ ROUTES: dict[str, Callable[[NodeContext, dict, Connection], dict]] = {
     MODEL_CENTRIC_FL_EVENTS.AUTHENTICATE: authenticate,
     MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST: cycle_request,
     MODEL_CENTRIC_FL_EVENTS.REPORT: report,
+    MODEL_CENTRIC_FL_EVENTS.REPORT_METRICS: report_metrics,
     MODEL_CENTRIC_FL_EVENTS.SECAGG_ADVERTISE: secagg_advertise,
     MODEL_CENTRIC_FL_EVENTS.SECAGG_ROSTER: secagg_roster,
     MODEL_CENTRIC_FL_EVENTS.SECAGG_SHARES: secagg_shares,

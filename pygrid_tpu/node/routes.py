@@ -248,6 +248,24 @@ async def mc_req_join(request: web.Request) -> web.Response:
         return _json_error(err, _status_for(err))
 
 
+async def mc_cycle_metrics(request: web.Request) -> web.Response:
+    """Per-cycle sample-weighted training metrics reported by workers
+    (this framework's extension — the reference has no structured
+    metrics, SURVEY §5.5; `/metrics` is the Prometheus exposition, this
+    is the FL-semantic curve)."""
+    ctx = _ctx(request)
+    try:
+        filters: dict[str, Any] = {"name": request.query.get("name")}
+        if request.query.get("version"):
+            filters["version"] = request.query.get("version")
+        process = ctx.fl.process_manager.first(**filters)
+        return web.json_response(
+            {"cycles": ctx.fl.cycle_manager.cycle_metrics(process.id)}
+        )
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
 async def mc_retrieve_model(request: web.Request) -> web.Response:
     """Public checkpoint download by name/version/checkpoint alias or number
     (reference routes.py:471-516)."""
@@ -538,6 +556,7 @@ def register(app: web.Application) -> None:
     r.add_get("/model-centric/get-protocol", mc_get_protocol)
     r.add_get("/model-centric/req-join", mc_req_join)
     r.add_get("/model-centric/retrieve-model", mc_retrieve_model)
+    r.add_get("/model-centric/cycle-metrics", mc_cycle_metrics)
     # data-centric (reference blueprint /data-centric)
     r.add_get("/data-centric/models/", dc_models)
     r.add_get("/data-centric/detailed-models-list/", dc_detailed_models)

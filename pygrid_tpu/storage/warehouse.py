@@ -223,6 +223,26 @@ class Warehouse:
         self.db.execute(
             f"CREATE TABLE IF NOT EXISTS {self.table} ({', '.join(cols)})"
         )
+        self._migrate_missing_columns()
+
+    def _migrate_missing_columns(self) -> None:
+        """Schema evolution for file-backed DBs: a dataclass can grow
+        fields across releases, but register() always INSERTs every field
+        — without ALTER TABLE, a node restarted on an old DB would fail
+        its first write. New columns arrive nullable with the dataclass
+        default semantics (reads of old rows yield the default)."""
+        existing = {
+            row[1]
+            for row in self.db.execute(
+                f"PRAGMA table_info({self.table})"
+            ).fetchall()
+        }
+        for f in self.fields:
+            if f.name not in existing:
+                self.db.execute(
+                    f"ALTER TABLE {self.table} ADD COLUMN "
+                    f'"{f.name}" {_column_type(f.type)}'
+                )
 
     # --- write --------------------------------------------------------------
 

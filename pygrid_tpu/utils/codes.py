@@ -53,6 +53,7 @@ class MODEL_CENTRIC_FL_EVENTS:
     REPORT = "model-centric/report"
     AUTHENTICATE = "model-centric/authenticate"
     CYCLE_REQUEST = "model-centric/cycle-request"
+    REPORT_METRICS = "model-centric/report-metrics"
     # secure-aggregation rounds (this framework's extension — the reference
     # has no SecAgg; names follow its model-centric/<verb> convention)
     SECAGG_ADVERTISE = "model-centric/secagg-advertise"

@@ -81,6 +81,19 @@ def run_worker(
                 new_params = list(out[-len(params):])
                 diff = [p - n for p, n in zip(params, new_params)]
                 job.report(diff)
+                # plan convention puts metrics first: (loss, acc, *params)
+                head = out[: len(out) - len(params)]
+                if head:
+                    try:
+                        client.report_metrics(
+                            job.worker_id,
+                            job.request_key,
+                            loss=float(head[0]),
+                            acc=float(head[1]) if len(head) > 1 else None,
+                            n_samples=batch_size,
+                        )
+                    except Exception:  # noqa: BLE001 — metrics are best-effort
+                        pass
                 result.accepted += 1
 
             def on_rejected(job: Any, timeout: Any) -> None:

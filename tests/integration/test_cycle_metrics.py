@@ -1,0 +1,165 @@
+"""Federated training metrics: workers attach {loss, acc, n_samples} to
+their assignments, the node aggregates sample-weighted per cycle and
+serves the fleet's training curve — no raw data leaves workers. This
+framework's extension (the reference has no structured metrics,
+SURVEY §5.5)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from pygrid_tpu.client import FLClient, ModelCentricFLClient
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.plans.state import serialize_model_params
+
+from .conftest import ServerThread, _free_port
+
+D, H, C, B = 10, 5, 3, 4
+NAME, VERSION = "metrics-demo", "1.0"
+
+
+@pytest.fixture(scope="module")
+def node():
+    from pygrid_tpu.federated import tasks
+    from pygrid_tpu.node import create_app
+
+    prev = tasks._sync
+    tasks.set_sync(True)
+    server = ServerThread(create_app("metrics-node"), _free_port()).start()
+    yield server
+    tasks.set_sync(prev)
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def hosted(node):
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(0), (D, H, C))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(node.url)
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": NAME, "version": VERSION,
+            "batch_size": B, "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": 2, "max_workers": 2,
+            "min_diffs": 2, "max_diffs": 2, "num_cycles": 2,
+            "do_not_reuse_workers_until_cycle": 0,
+            "pool_selection": "random",
+        },
+    )
+    assert resp.get("status") == "success", resp
+    mc.close()
+    return params
+
+
+def _join(node):
+    client = FLClient(node.url, timeout=30.0)
+    wid = client.authenticate(NAME, VERSION)["worker_id"]
+    cyc = client.cycle_request(
+        wid, NAME, VERSION, ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cyc.get("status") == "accepted", cyc
+    return client, wid, cyc
+
+
+def test_metrics_aggregate_sample_weighted(node, hosted):
+    params = hosted
+    a, wa, cyca = _join(node)
+    b, wb, cycb = _join(node)
+    diff = [0.01 * np.asarray(p) for p in params]
+    blob = serialize_model_params(diff)
+
+    # A reports metrics BEFORE its diff; B after (and after the cycle
+    # completes — late metrics must still attach)
+    out = a.report_metrics(wa, cyca["request_key"], loss=2.0, acc=0.5,
+                           n_samples=100)
+    assert out.get("status") == "success", out
+    a.report(wa, cyca["request_key"], blob)
+    b.report(wb, cycb["request_key"], blob)  # cycle 1 completes here
+    out = b.report_metrics(wb, cycb["request_key"], loss=1.0, acc=0.8,
+                           n_samples=300)
+    assert out.get("status") == "success", out
+
+    mc = ModelCentricFLClient(node.url)
+    cycles = mc.cycle_metrics(NAME, VERSION)
+    entry = next(e for e in cycles if e["cycle"] == 1)
+    assert entry["reports"] == 2 and entry["completed"]
+    # sample-weighted: loss (2·100 + 1·300)/400 = 1.25; acc = 0.725
+    assert entry["loss"] == pytest.approx(1.25)
+    assert entry["acc"] == pytest.approx(0.725)
+    mc.close()
+    for c in (a, b):
+        c.close()
+
+
+def test_metrics_validation(node, hosted):
+    a, wa, cyca = _join(node)
+    out = a.report_metrics(wa, cyca["request_key"], loss=float("nan"))
+    assert "error" in out, out
+    out = a.report_metrics(wa, cyca["request_key"], loss=1e300)
+    assert "error" in out, out
+    out = a.report_metrics(wa, cyca["request_key"], n_samples=0, loss=1.0)
+    assert "error" in out, out
+    out = a.report_metrics(wa, cyca["request_key"], n_samples=10**7, loss=1.0)
+    assert "error" in out, out
+    out = a.report_metrics(wa, cyca["request_key"])  # neither loss nor acc
+    assert "error" in out, out
+    out = a.report_metrics("nobody", "badkey", loss=1.0)
+    assert "error" in out, out
+    a.close()
+
+
+def test_metrics_refused_for_privacy_configured_process(node):
+    """A per-client loss is a membership-inference signal — processes
+    paying for DP noise must not leak it through the metrics side door."""
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(9), (D, H, C))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(node.url)
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": "metrics-dp", "version": VERSION,
+            "batch_size": B, "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": 1, "max_workers": 1,
+            "min_diffs": 1, "max_diffs": 1, "num_cycles": 1,
+            "differential_privacy": {"clip_norm": 1.0,
+                                     "noise_multiplier": 0.0},
+        },
+    )
+    assert resp.get("status") == "success", resp
+    mc.close()
+    client = FLClient(node.url, timeout=30.0)
+    wid = client.authenticate("metrics-dp", VERSION)["worker_id"]
+    cyc = client.cycle_request(
+        wid, "metrics-dp", VERSION, ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cyc.get("status") == "accepted", cyc
+    out = client.report_metrics(wid, cyc["request_key"], loss=1.0)
+    assert "error" in out and "membership-inference" in out["error"], out
+    client.close()

@@ -108,3 +108,39 @@ def test_file_backed_wal_concurrent_threads(tmp_path):
     assert not errors, errors
     assert wh.count() == N_THREADS * N_EACH
     db.close()
+
+
+def test_schema_evolution_adds_missing_columns(tmp_path):
+    """A dataclass gaining fields across releases must not break writes
+    against a file DB created by an older build: _create_table ALTERs the
+    missing columns in; old rows read back the NULL→None default."""
+    import dataclasses
+
+    from pygrid_tpu.storage.warehouse import Database, Warehouse
+
+    path = str(tmp_path / "old.db")
+
+    @dataclasses.dataclass
+    class Thing:
+        id: int | None = None
+        name: str = ""
+
+    db = Database(path)
+    old = Warehouse(Thing, db)
+    old.register(name="legacy-row")
+
+    @dataclasses.dataclass
+    class Thing:  # noqa: F811 — the "new release" shape, same table name
+        id: int | None = None
+        name: str = ""
+        extra: int = 0
+        blob: bytes | None = None
+
+    new = Warehouse(Thing, Database(path))
+    # the old row reads with NULLs for the new columns
+    legacy = new.first(name="legacy-row")
+    assert legacy is not None and legacy.extra is None and legacy.blob is None
+    # and writes with the new columns succeed
+    row = new.register(name="fresh", extra=7, blob=b"x")
+    got = new.first(id=row.id)
+    assert got.extra == 7 and got.blob == b"x"
