@@ -390,6 +390,37 @@ class CycleManager:
 
         self._worker_cycles.modify({"id": wc.id}, {"metrics": serialize(clean)})
 
+    def latest_metrics(self, fl_process_id: int) -> dict | None:
+        """The newest cycle entry that has any reported metrics, or None.
+        Walks cycles newest-first and stops at the first hit, so the
+        dashboard's poll stays O(recent) instead of re-aggregating the
+        whole history every refresh."""
+        from pygrid_tpu.serde import deserialize
+
+        cycles = sorted(
+            self._cycles.query(fl_process_id=fl_process_id),
+            key=lambda c: c.sequence,
+            reverse=True,
+        )
+        for cycle in cycles:
+            totals: dict[str, float] = {}
+            weights: dict[str, float] = {}
+            for wc in self._worker_cycles.query(cycle_id=cycle.id):
+                if not wc.metrics:
+                    continue
+                m = deserialize(wc.metrics)
+                n = float(m.get("n_samples", 1))
+                for key in ("loss", "acc"):
+                    if key in m:
+                        totals[key] = totals.get(key, 0.0) + m[key] * n
+                        weights[key] = weights.get(key, 0.0) + n
+            if totals:
+                entry = {"cycle": cycle.sequence}
+                for key, total in totals.items():
+                    entry[key] = total / weights[key]
+                return entry
+        return None
+
     def cycle_metrics(self, fl_process_id: int) -> list[dict]:
         """Per-cycle sample-weighted aggregation of reported metrics —
         the fleet's training curve without any raw data leaving workers."""

@@ -32,6 +32,11 @@ PAGE = """<!doctype html>
 <body>
 <h1>pygrid-tpu node <code>{node_id}</code></h1>
 <p class="muted" id="status">loading status…</p>
+<h2>FL processes</h2>
+<table id="fl"><thead>
+<tr><th>name</th><th>version</th><th>cycles</th><th>latest loss</th>
+<th>latest acc</th></tr>
+</thead><tbody></tbody></table>
 <h2>Hosted models</h2>
 <table id="models"><thead>
 <tr><th>id</th><th>download</th><th>remote inference</th><th>mpc</th></tr>
@@ -64,6 +69,24 @@ async function refresh() {{
     for (const m of models) {{
       tbody.appendChild(
         row([m.id, m.allow_download, m.allow_remote_inference, m.mpc]));
+    }}
+    const fl = await (await fetch('/model-centric/processes')).json();
+    const flBody = document.querySelector('#fl tbody');
+    flBody.replaceChildren();
+    const procs = fl.processes || [];
+    if (!procs.length) {{
+      const tr = document.createElement('tr');
+      const td = document.createElement('td');
+      td.colSpan = 5; td.className = 'muted'; td.textContent = 'none';
+      tr.appendChild(td); flBody.appendChild(tr);
+    }}
+    for (const p of procs) {{
+      const m = p.latest_metrics || {{}};
+      flBody.appendChild(row([
+        p.name, p.version,
+        p.cycles_completed + '/' + p.cycles_total,
+        'loss' in m ? m.loss.toFixed(4) : '—',
+        'acc' in m ? m.acc.toFixed(4) : '—']));
     }}
   }} catch (err) {{
     document.getElementById('status').textContent = 'error: ' + err;

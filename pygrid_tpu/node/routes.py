@@ -248,6 +248,35 @@ async def mc_req_join(request: web.Request) -> web.Response:
         return _json_error(err, _status_for(err))
 
 
+async def mc_processes(request: web.Request) -> web.Response:
+    """Hosted FL processes with cycle progress — feeds the dashboard's
+    FL section (no reference analog; its dashboard lists only
+    data-centric models)."""
+    ctx = _ctx(request)
+    try:
+        out = []
+        for process in ctx.fl.process_manager.get():
+            entry = {
+                "name": process.name,
+                "version": process.version,
+                "cycles_completed": ctx.fl.cycle_manager.count_cycles(
+                    fl_process_id=process.id, is_completed=True
+                ),
+                "cycles_total": ctx.fl.cycle_manager.count_cycles(
+                    fl_process_id=process.id
+                ),
+            }
+            # latest aggregated metrics embedded so the dashboard poll is
+            # one request, not one per process per refresh
+            latest = ctx.fl.cycle_manager.latest_metrics(process.id)
+            if latest:
+                entry["latest_metrics"] = latest
+            out.append(entry)
+        return web.json_response({"processes": out})
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
 async def mc_cycle_metrics(request: web.Request) -> web.Response:
     """Per-cycle sample-weighted training metrics reported by workers
     (this framework's extension — the reference has no structured
@@ -557,6 +586,7 @@ def register(app: web.Application) -> None:
     r.add_get("/model-centric/req-join", mc_req_join)
     r.add_get("/model-centric/retrieve-model", mc_retrieve_model)
     r.add_get("/model-centric/cycle-metrics", mc_cycle_metrics)
+    r.add_get("/model-centric/processes", mc_processes)
     # data-centric (reference blueprint /data-centric)
     r.add_get("/data-centric/models/", dc_models)
     r.add_get("/data-centric/detailed-models-list/", dc_detailed_models)

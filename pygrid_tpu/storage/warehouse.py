@@ -229,8 +229,10 @@ class Warehouse:
         """Schema evolution for file-backed DBs: a dataclass can grow
         fields across releases, but register() always INSERTs every field
         — without ALTER TABLE, a node restarted on an old DB would fail
-        its first write. New columns arrive nullable with the dataclass
-        default semantics (reads of old rows yield the default)."""
+        its first write. Scalar dataclass defaults are emitted as column
+        DEFAULTs so sqlite backfills PRE-migration rows with them; fields
+        defaulting to None (or with non-scalar defaults) read back None
+        for old rows."""
         existing = {
             row[1]
             for row in self.db.execute(
@@ -238,11 +240,21 @@ class Warehouse:
             ).fetchall()
         }
         for f in self.fields:
-            if f.name not in existing:
-                self.db.execute(
-                    f"ALTER TABLE {self.table} ADD COLUMN "
-                    f'"{f.name}" {_column_type(f.type)}'
-                )
+            if f.name in existing:
+                continue
+            ddl = (
+                f"ALTER TABLE {self.table} ADD COLUMN "
+                f'"{f.name}" {_column_type(f.type)}'
+            )
+            default = getattr(f, "default", None)
+            if isinstance(default, bool):
+                ddl += f" DEFAULT {int(default)}"
+            elif isinstance(default, (int, float)):
+                ddl += f" DEFAULT {default!r}"
+            elif isinstance(default, str):
+                escaped = default.replace("'", "''")
+                ddl += f" DEFAULT '{escaped}'"
+            self.db.execute(ddl)
 
     # --- write --------------------------------------------------------------
 

@@ -90,7 +90,7 @@ def run_worker(
                             job.request_key,
                             loss=float(head[0]),
                             acc=float(head[1]) if len(head) > 1 else None,
-                            n_samples=batch_size,
+                            n_samples=len(X),  # actual rows, not requested
                         )
                     except Exception:  # noqa: BLE001 — metrics are best-effort
                         pass

@@ -107,6 +107,22 @@ def test_metrics_aggregate_sample_weighted(node, hosted):
         c.close()
 
 
+def test_processes_listing(node, hosted):
+    import requests
+
+    resp = requests.get(node.url + "/model-centric/processes", timeout=10)
+    assert resp.status_code == 200
+    procs = resp.json()["processes"]
+    entry = next(p for p in procs if p["name"] == NAME)
+    assert entry["version"] == VERSION
+    assert entry["cycles_total"] >= entry["cycles_completed"] >= 1
+    # latest aggregated metrics embedded (one dashboard poll, not N)
+    latest = entry["latest_metrics"]
+    assert latest["cycle"] == 1
+    assert latest["loss"] == pytest.approx(1.25)
+    assert latest["acc"] == pytest.approx(0.725)
+
+
 def test_metrics_validation(node, hosted):
     a, wa, cyca = _join(node)
     out = a.report_metrics(wa, cyca["request_key"], loss=float("nan"))

@@ -137,9 +137,9 @@ def test_schema_evolution_adds_missing_columns(tmp_path):
         blob: bytes | None = None
 
     new = Warehouse(Thing, Database(path))
-    # the old row reads with NULLs for the new columns
+    # the old row backfills scalar defaults; None-default columns read None
     legacy = new.first(name="legacy-row")
-    assert legacy is not None and legacy.extra is None and legacy.blob is None
+    assert legacy is not None and legacy.extra == 0 and legacy.blob is None
     # and writes with the new columns succeed
     row = new.register(name="fresh", extra=7, blob=b"x")
     got = new.first(id=row.id)
