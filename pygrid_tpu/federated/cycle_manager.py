@@ -390,65 +390,60 @@ class CycleManager:
 
         self._worker_cycles.modify({"id": wc.id}, {"metrics": serialize(clean)})
 
+    def _aggregate_cycle_metrics(self, cycle_id: int) -> tuple[dict, int]:
+        """Sample-weighted (metric → mean, n_reports) for one cycle — the
+        single aggregation both the full curve and the dashboard's latest
+        value go through, so they cannot drift."""
+        from pygrid_tpu.serde import deserialize
+
+        totals: dict[str, float] = {}
+        weights: dict[str, float] = {}
+        n_reports = 0
+        for wc in self._worker_cycles.query(cycle_id=cycle_id):
+            if not wc.metrics:
+                continue
+            m = deserialize(wc.metrics)
+            n = float(m.get("n_samples", 1))
+            n_reports += 1
+            for key in ("loss", "acc"):
+                if key in m:
+                    totals[key] = totals.get(key, 0.0) + m[key] * n
+                    weights[key] = weights.get(key, 0.0) + n
+        return (
+            {key: total / weights[key] for key, total in totals.items()},
+            n_reports,
+        )
+
     def latest_metrics(self, fl_process_id: int) -> dict | None:
         """The newest cycle entry that has any reported metrics, or None.
         Walks cycles newest-first and stops at the first hit, so the
         dashboard's poll stays O(recent) instead of re-aggregating the
         whole history every refresh."""
-        from pygrid_tpu.serde import deserialize
-
         cycles = sorted(
             self._cycles.query(fl_process_id=fl_process_id),
             key=lambda c: c.sequence,
             reverse=True,
         )
         for cycle in cycles:
-            totals: dict[str, float] = {}
-            weights: dict[str, float] = {}
-            for wc in self._worker_cycles.query(cycle_id=cycle.id):
-                if not wc.metrics:
-                    continue
-                m = deserialize(wc.metrics)
-                n = float(m.get("n_samples", 1))
-                for key in ("loss", "acc"):
-                    if key in m:
-                        totals[key] = totals.get(key, 0.0) + m[key] * n
-                        weights[key] = weights.get(key, 0.0) + n
-            if totals:
-                entry = {"cycle": cycle.sequence}
-                for key, total in totals.items():
-                    entry[key] = total / weights[key]
-                return entry
+            means, _ = self._aggregate_cycle_metrics(cycle.id)
+            if means:
+                return {"cycle": cycle.sequence, **means}
         return None
 
     def cycle_metrics(self, fl_process_id: int) -> list[dict]:
         """Per-cycle sample-weighted aggregation of reported metrics —
         the fleet's training curve without any raw data leaving workers."""
-        from pygrid_tpu.serde import deserialize
-
         out = []
         for cycle in self._cycles.query(fl_process_id=fl_process_id):
-            totals: dict[str, float] = {}
-            weights: dict[str, float] = {}
-            n_reports = 0
-            for wc in self._worker_cycles.query(cycle_id=cycle.id):
-                if not wc.metrics:
-                    continue
-                m = deserialize(wc.metrics)
-                n = float(m.get("n_samples", 1))
-                n_reports += 1
-                for key in ("loss", "acc"):
-                    if key in m:
-                        totals[key] = totals.get(key, 0.0) + m[key] * n
-                        weights[key] = weights.get(key, 0.0) + n
-            entry: dict = {
-                "cycle": cycle.sequence,
-                "completed": bool(cycle.is_completed),
-                "reports": n_reports,
-            }
-            for key, total in totals.items():
-                entry[key] = total / weights[key]
-            out.append(entry)
+            means, n_reports = self._aggregate_cycle_metrics(cycle.id)
+            out.append(
+                {
+                    "cycle": cycle.sequence,
+                    "completed": bool(cycle.is_completed),
+                    "reports": n_reports,
+                    **means,
+                }
+            )
         return sorted(out, key=lambda e: e["cycle"])
 
     def _decode_and_check(self, diff: bytes, fl_process_id: int) -> list:

@@ -102,25 +102,26 @@ def test_metrics_aggregate_sample_weighted(node, hosted):
     # sample-weighted: loss (2·100 + 1·300)/400 = 1.25; acc = 0.725
     assert entry["loss"] == pytest.approx(1.25)
     assert entry["acc"] == pytest.approx(0.725)
-    mc.close()
-    for c in (a, b):
-        c.close()
 
-
-def test_processes_listing(node, hosted):
+    # the process listing (dashboard feed) embeds the same aggregate —
+    # asserted here, in the test that produced the state, so the check
+    # also runs standalone
     import requests
 
     resp = requests.get(node.url + "/model-centric/processes", timeout=10)
     assert resp.status_code == 200
-    procs = resp.json()["processes"]
-    entry = next(p for p in procs if p["name"] == NAME)
-    assert entry["version"] == VERSION
-    assert entry["cycles_total"] >= entry["cycles_completed"] >= 1
-    # latest aggregated metrics embedded (one dashboard poll, not N)
-    latest = entry["latest_metrics"]
+    listing = next(
+        p for p in resp.json()["processes"] if p["name"] == NAME
+    )
+    assert listing["version"] == VERSION
+    assert listing["cycles_total"] >= listing["cycles_completed"] >= 1
+    latest = listing["latest_metrics"]
     assert latest["cycle"] == 1
     assert latest["loss"] == pytest.approx(1.25)
     assert latest["acc"] == pytest.approx(0.725)
+    mc.close()
+    for c in (a, b):
+        c.close()
 
 
 def test_metrics_validation(node, hosted):

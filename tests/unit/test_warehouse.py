@@ -113,7 +113,8 @@ def test_file_backed_wal_concurrent_threads(tmp_path):
 def test_schema_evolution_adds_missing_columns(tmp_path):
     """A dataclass gaining fields across releases must not break writes
     against a file DB created by an older build: _create_table ALTERs the
-    missing columns in; old rows read back the NULL→None default."""
+    missing columns in, scalar defaults backfill pre-migration rows via
+    column DEFAULTs, and None-default columns read back None."""
     import dataclasses
 
     from pygrid_tpu.storage.warehouse import Database, Warehouse
