@@ -111,6 +111,17 @@ class FLJob:
         next report (error feedback — federated/compression.py)."""
         import numpy as np
 
+        local_dp = self.client_config.get("local_dp")
+        if local_dp:
+            # client-side clip + noise BEFORE anything ships — composes
+            # with secure aggregation, unlike server-side DP
+            from pygrid_tpu.federated.privacy import local_dp_noise
+
+            diff_params = local_dp_noise(
+                diff_params,
+                float(local_dp["clip_norm"]),
+                float(local_dp.get("noise_multiplier", 0.0)),
+            )
         precision = self.diff_precision or self.client_config.get("diff_precision")
         bf16 = precision == "bf16"
         compression = (

@@ -44,10 +44,21 @@ class SecAggRefusal(PyGridError):
 
 
 class SecAggSession:
-    def __init__(self, fl_client, worker_id: str, request_key: str) -> None:
+    def __init__(
+        self,
+        fl_client,
+        worker_id: str,
+        request_key: str,
+        client_config: dict | None = None,
+    ) -> None:
+        """``client_config`` is the hosted process's client config (from
+        the cycle-request response) — pass it so ``local_dp`` applies to
+        reports; SecAgg masks whatever it is given, and client-side DP
+        is the only DP that composes with it."""
         self.client = fl_client
         self.worker_id = worker_id
         self.request_key = request_key
+        self.client_config = client_config or {}
         self.keypair = secagg.DHKeyPair.generate()
         self.self_seed = secrets.token_bytes(16)
         self.roster: dict[str, int] = {}
@@ -155,6 +166,17 @@ class SecAggSession:
     def masked_blob(self, diffs: Sequence[np.ndarray]) -> bytes:
         if not self.mask_set:
             raise PyGridError("wait_masking first")
+        local_dp = self.client_config.get("local_dp")
+        if local_dp:
+            # clip + noise BEFORE quantize/mask: the only DP that
+            # composes with secure aggregation is the client-side kind
+            from pygrid_tpu.federated.privacy import local_dp_noise
+
+            diffs = local_dp_noise(
+                diffs,
+                float(local_dp["clip_norm"]),
+                float(local_dp.get("noise_multiplier", 0.0)),
+            )
         quantized = secagg.quantize(diffs, self.clip_range, len(self.mask_set))
         masked = secagg.mask_quantized(
             quantized,

@@ -77,6 +77,22 @@ class FLController:
                     "averaging plan (noise is calibrated to the mean's "
                     "C/K sensitivity)"
                 )
+        local_dp = (client_config or {}).get("local_dp")
+        if local_dp is not None:
+            # client-side DP — validated here so a bad config fails the
+            # hosting call, not every worker's report. Unlike server-side
+            # DP it composes with secure_aggregation (each report is
+            # private before masking), so no combination gate.
+            if not isinstance(local_dp, dict):
+                raise E.PyGridError(
+                    "local_dp must be a dict {clip_norm, noise_multiplier}"
+                )
+            clip = local_dp.get("clip_norm")
+            if not isinstance(clip, (int, float)) or clip <= 0:
+                raise E.PyGridError("local_dp requires a positive clip_norm")
+            if float(local_dp.get("noise_multiplier", 0.0)) < 0:
+                raise E.PyGridError("local_dp noise_multiplier must be >= 0")
+
         async_cfg = server_config.get("async_aggregation")
         if async_cfg is not None:
             if not isinstance(async_cfg, dict):

@@ -102,6 +102,7 @@ class CycleManager:
         self._dp_cache: dict[int, dict | None] = {}
         self._async_cache: dict[int, dict | None] = {}
         self._robust_cache: dict[int, dict | None] = {}
+        self._local_dp_cache: dict[int, dict | None] = {}
         # the FedBuff buffer is PROCESS-scoped, not cycle-scoped: an ingest
         # racing a flush then lands either before the pop (flushed now) or
         # after (first entry of the next buffer) — no orphaned cycle-keyed
@@ -367,11 +368,13 @@ class CycleManager:
         if (
             self._dp_config(pid) is not None
             or self.secagg.config_for(pid) is not None
+            or self._local_dp_config(pid) is not None
         ):
             raise E.PyGridError(
                 "per-client metrics are not stored for processes with "
-                "differential_privacy or secure_aggregation (individual "
-                "training loss is a membership-inference signal)"
+                "differential_privacy, local_dp, or secure_aggregation "
+                "(individual training loss is a membership-inference "
+                "signal that would void what those features pay for)"
             )
         clean: dict[str, float] = {}
         for key in ("loss", "acc"):
@@ -507,6 +510,21 @@ class CycleManager:
         return self._cached_server_section(
             self._robust_cache, fl_process_id, "robust_aggregation"
         )
+
+    def _local_dp_config(self, fl_process_id: int) -> dict | None:
+        """client_config's local_dp section (cached; CLIENT config, so
+        not servable by _cached_server_section)."""
+        cached = self._local_dp_cache.get(fl_process_id, _UNSET)
+        if cached is _UNSET:
+            client_config = self.process_manager.get_configs(
+                fl_process_id=fl_process_id, is_server_config=False
+            )
+            raw = client_config.get("local_dp")
+            if raw is not None and not isinstance(raw, dict):
+                raise E.PyGridError("local_dp must be a dict")
+            cached = raw or None
+            self._local_dp_cache[fl_process_id] = cached
+        return cached
 
     def _model_shapes(self, fl_process_id: int) -> list[tuple]:
         """Expected diff tensor shapes — the model's parameter shapes, fixed

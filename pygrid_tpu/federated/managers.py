@@ -224,6 +224,14 @@ class ModelManager:
 
     def load(self, **filters: Any) -> S.ModelCheckPoint:
         ckpt = self._checkpoints.last(**filters)
+        if ckpt is None and filters.get("alias") == "latest":
+            # save() re-aliases in two statements (clear old, insert new);
+            # a reader landing between them finds NO "latest" row. The
+            # newest checkpoint IS the latest — fall back to it instead
+            # of 404ing mid-aggregation
+            fallback = dict(filters)
+            fallback.pop("alias")
+            ckpt = self._checkpoints.last(**fallback)
         if ckpt is None:
             raise E.CheckPointNotFound()
         return ckpt

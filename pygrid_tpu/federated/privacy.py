@@ -50,6 +50,33 @@ def clip_diff(
     return [(np.asarray(t, dtype=np.float32) * np.float32(scale)) for t in diff]
 
 
+def local_dp_noise(
+    diff: Sequence[np.ndarray],
+    clip_norm: float,
+    noise_multiplier: float,
+) -> list[np.ndarray]:
+    """CLIENT-side DP (local/distributed DP): clip the own diff to
+    L2 ≤ C and add N(0, (z·C)²) per coordinate BEFORE it leaves the
+    device. Unlike server-side DP-FedAvg (which the node applies and
+    SecAgg therefore forbids — the node never sees individuals), local
+    noise composes with secure aggregation: each client's report is
+    already private on its own, and the masked sum the server learns
+    carries the aggregate noise. σ is z·C (not z·C/K): the client
+    protects itself without trusting the server to noise anything.
+    Post-processing invariance means compression after this is safe."""
+    clipped = clip_diff(diff, clip_norm)
+    if noise_multiplier < 0:
+        raise PyGridError("noise_multiplier must be >= 0")
+    if noise_multiplier == 0:
+        return clipped
+    sigma = noise_multiplier * clip_norm
+    rng = np.random.default_rng()  # OS entropy — never seeded
+    return [
+        t + rng.normal(0.0, sigma, size=t.shape).astype(np.float32)
+        for t in clipped
+    ]
+
+
 def add_gaussian_noise(
     avg_diff: Sequence[np.ndarray],
     clip_norm: float,

@@ -186,3 +186,19 @@ def test_dp_with_custom_avg_plan_rejected():
                            "differential_privacy": {"clip_norm": 1.0}},
             server_averaging_plan=avg_plan,
         )
+
+
+def test_local_dp_noise_clips_then_noises():
+    from pygrid_tpu.federated.privacy import local_dp_noise
+
+    d = [np.full((1000,), 1.0, np.float32)]  # L2 ≈ 31.6 » clip 1.0
+    out = local_dp_noise(d, clip_norm=1.0, noise_multiplier=0.0)
+    assert abs(global_l2_norm(out) - 1.0) < 1e-5  # clip only when z=0
+
+    noised = local_dp_noise(d, clip_norm=1.0, noise_multiplier=0.5)
+    delta = noised[0] - out[0]
+    # per-coordinate σ = z·C = 0.5; sample std over 1000 coords near it
+    assert 0.4 < float(np.std(delta)) < 0.6
+    # fresh OS entropy per call — two calls differ
+    noised2 = local_dp_noise(d, clip_norm=1.0, noise_multiplier=0.5)
+    assert not np.allclose(noised[0], noised2[0])

@@ -92,6 +92,7 @@ def test_pairwise_masks_cancel_for_any_party_count(n, size, seed):
     q = {
         w: [rng.integers(0, 1 << 32, size, dtype=np.uint32)] for w in wids
     }
+    seeds = {w: bytes([i + 1]) * 16 for i, w in enumerate(wids)}
     total_plain = np.zeros(size, np.uint32)
     total_masked = np.zeros(size, np.uint32)
     for w in wids:
@@ -100,13 +101,11 @@ def test_pairwise_masks_cancel_for_any_party_count(n, size, seed):
             for o in wids
             if o != w
         }
-        y = secagg.mask_quantized(q[w], w, bytes([hash(w) % 256]) * 16, pair)
+        y = secagg.mask_quantized(q[w], w, seeds[w], pair)
         np.add(total_plain, q[w][0], out=total_plain)
         np.add(total_masked, y[0], out=total_masked)
     unmasked = secagg.remove_self_masks(
-        [total_masked],
-        [bytes([hash(w) % 256]) * 16 for w in wids],
-        [(size,)],
+        [total_masked], [seeds[w] for w in wids], [(size,)]
     )
     np.testing.assert_array_equal(unmasked[0], total_plain)
 
