@@ -102,6 +102,14 @@ class SecAggSession:
                     for wid, pub in out["roster"].items()
                 }
                 self.threshold = int(out["threshold"])
+                if self.threshold <= len(self.roster) // 2:
+                    # a sub-majority threshold would let a malicious server
+                    # play disjoint t-quorums against each other to unmask
+                    # an individual report — refuse to participate
+                    raise SecAggRefusal(
+                        f"server sent sub-majority secagg threshold "
+                        f"{self.threshold} for roster of {len(self.roster)}"
+                    )
                 self.clip_range = float(out["clip_range"])
                 for wid, pub in self.roster.items():
                     if wid != self.worker_id:

@@ -135,6 +135,15 @@ class SecAggService:
         t = sa.get("threshold")
         if t is not None and not (2 <= int(t) <= int(roster)):
             raise E.PyGridError("secure_aggregation threshold out of range")
+        if t is not None and int(t) <= int(roster) // 2:
+            # Bonawitz's guarantee against a malicious server needs an
+            # honest-majority threshold: with t <= n/2 the server could
+            # feed two disjoint t-quorums contradictory survivor/dropout
+            # views and collect both b_i and sk_i shares for one client
+            raise E.PyGridError(
+                f"secure_aggregation threshold must exceed roster/2 "
+                f"({t} <= {int(roster) // 2} of roster {roster})"
+            )
         # readiness must never freeze a survivor set smaller than the
         # unmask threshold — such cycles would fail at unmask time, every
         # time, with only a server-side log to show for it
@@ -322,17 +331,37 @@ class SecAggService:
         )
 
     def _masking_deadline(self, cycle_id: int) -> None:
+        # fetched before the lock: DB work never runs under the service lock
+        context = self._cm._cycle_context(cycle_id)
+        cycle, server_config = (
+            (context[0], context[2]) if context is not None else (None, {})
+        )
+        min_diffs = server_config.get("min_diffs")
+        proceed = False
         failed = False
         with self._lock:
             st = self._cycles.get(cycle_id)
             if st is None or st.phase != MASKING:
                 return
-            logger.warning(
-                "secagg cycle %s: masking deadline with %s/%s reports — "
-                "failing", cycle_id, len(st.reported), len(st.mask_set),
-            )
-            failed = self._fail_locked(cycle_id)
-        if failed:
+            if (
+                cycle is not None
+                and min_diffs is not None
+                and len(st.reported) >= int(min_diffs)
+            ):
+                # the deadline is readiness here, not failure: enough masked
+                # reports arrived but the cycle's own readiness never fired
+                # (cycle_length > masking_timeout, or max_diffs unreached) —
+                # aggregating what we have beats discarding it
+                proceed = True
+            else:
+                logger.warning(
+                    "secagg cycle %s: masking deadline with %s/%s reports — "
+                    "failing", cycle_id, len(st.reported), len(st.mask_set),
+                )
+                failed = self._fail_locked(cycle_id)
+        if proceed:
+            self.begin_unmasking(cycle, server_config)
+        elif failed:
             self._cm.close_failed_cycle(cycle_id)
 
     # ── round 2: masked report ingest (called by CycleManager) ──────────────

@@ -47,7 +47,14 @@ def _json_error(err: Exception, status: int) -> web.Response:
 def _status_for(err: Exception) -> int:
     if isinstance(err, E.InvalidRequestKeyError):
         return 401
-    if isinstance(err, (E.ModelNotFoundError, E.CheckPointNotFound)):
+    if isinstance(
+        err,
+        (
+            E.ModelNotFoundError,
+            E.CheckPointNotFound,
+            E.FLProcessNotFoundError,
+        ),
+    ):
         return 404
     if isinstance(err, E.PyGridError):
         return 400

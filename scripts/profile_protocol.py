@@ -1,0 +1,158 @@
+"""Diagnostic: isolate the node-side per-report handler cost (no sockets).
+
+Drives `route_requests` directly with authenticate → cycle-request →
+report messages for W workers × R cycles, timing each phase — the
+load-independent twin of bench.py's protocol bench. Run:
+
+    python scripts/profile_protocol.py [--wire json|binary] [--profile]
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import cProfile
+import io
+import json
+import pstats
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+W, R = 16, 3
+SIZES = (784, 392, 10)
+BATCH = 64
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--wire", default="json", choices=["json", "binary"])
+    ap.add_argument("--profile", action="store_true")
+    args = ap.parse_args()
+    bf16 = args.wire == "binary"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pygrid_tpu.federated import tasks
+    from pygrid_tpu.models import mlp
+    from pygrid_tpu.node import NodeContext
+    from pygrid_tpu.node.events import Connection, route_requests
+    from pygrid_tpu.plans.plan import Plan
+    from pygrid_tpu.plans.state import serialize_model_params
+    from pygrid_tpu.serde import deserialize, serialize
+
+    tasks.set_sync(True)
+    ctx = NodeContext("profile-node")
+    params = [np.asarray(p) for p in mlp.init(jax.random.PRNGKey(0), SIZES)]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((BATCH, SIZES[0]), np.float32),
+        np.zeros((BATCH, SIZES[-1]), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    from pygrid_tpu.serde import to_hex
+
+    ctx.fl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": bytes.fromhex(to_hex(plan))},
+        name="prof", version="1.0",
+        client_config={"name": "prof", "version": "1.0"},
+        server_config={
+            "min_workers": W, "max_workers": W,
+            "min_diffs": W, "max_diffs": W, "num_cycles": R + 1,
+            "do_not_reuse_workers_until_cycle": 0,
+            "pool_selection": "random",
+        },
+        server_averaging_plan=None,
+        client_protocols={},
+    )
+
+    diff = [0.01 * p for p in params]
+    blob = serialize_model_params(diff, bf16=bf16)
+
+    def send_json(conn, msg_type, data):
+        out = route_requests(
+            ctx, json.dumps({"type": msg_type, "data": data}), conn
+        )
+        return json.loads(out)["data"]
+
+    def send_bin(conn, msg_type, data):
+        out = route_requests(
+            ctx, serialize({"type": msg_type, "data": data}), conn
+        )
+        return deserialize(out)["data"]
+
+    send = send_bin if bf16 else send_json
+
+    phase_t: dict[str, list[float]] = {}
+
+    def timed(name, fn, *a):
+        t0 = time.perf_counter()
+        out = fn(*a)
+        phase_t.setdefault(name, []).append(time.perf_counter() - t0)
+        return out
+
+    conns = [Connection(ctx, socket=object()) for _ in range(W)]
+    wids = []
+    for conn in conns:
+        out = timed(
+            "auth", send, conn, "model-centric/authenticate",
+            {"model_name": "prof", "model_version": "1.0"},
+        )
+        wids.append(out["worker_id"])
+
+    profiler = cProfile.Profile() if args.profile else None
+
+    t_all0 = time.perf_counter()
+    for _ in range(R):
+        keys = []
+        for conn, wid in zip(conns, wids):
+            out = timed(
+                "cycle_request", send, conn, "model-centric/cycle-request",
+                {"worker_id": wid, "model": "prof", "version": "1.0",
+                 "ping": 1.0, "download": 1000.0, "upload": 1000.0},
+            )
+            assert out.get("status") == "accepted", out
+            keys.append(out["request_key"])
+        if profiler:
+            profiler.enable()
+        for conn, wid, key in zip(conns, wids, keys):
+            payload = (
+                blob if bf16 else base64.b64encode(blob).decode()
+            )
+            out = timed(
+                "report", send, conn, "model-centric/report",
+                {"worker_id": wid, "request_key": key, "diff": payload},
+            )
+            assert out.get("status") == "success", out
+        if profiler:
+            profiler.disable()
+    wall = time.perf_counter() - t_all0
+
+    for name, ts in phase_t.items():
+        arr = np.asarray(ts) * 1e3
+        print(
+            f"{name:14s} n={len(arr):3d}  mean={arr.mean():7.2f} ms  "
+            f"p50={np.percentile(arr, 50):7.2f}  max={arr.max():7.2f}",
+            file=sys.stderr,
+        )
+    n_reports = W * R
+    print(
+        f"wall {wall:.2f}s for {n_reports} reports "
+        f"({n_reports / wall:.1f} reports/sec incl. cycle completion)",
+        file=sys.stderr,
+    )
+    if profiler:
+        s = io.StringIO()
+        pstats.Stats(profiler, stream=s).sort_stats("cumulative").print_stats(30)
+        print(s.getvalue(), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

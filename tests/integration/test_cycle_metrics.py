@@ -180,3 +180,16 @@ def test_metrics_refused_for_privacy_configured_process(node):
     out = client.report_metrics(wid, cyc["request_key"], loss=1.0)
     assert "error" in out and "membership-inference" in out["error"], out
     client.close()
+
+
+def test_unknown_process_returns_404(node):
+    """An unknown name/version must be a clean 404, not an
+    AttributeError-backed 500 (ProcessNotFoundError contract)."""
+    import requests
+
+    for path in ("/model-centric/cycle-metrics", "/model-centric/retrieve-model"):
+        resp = requests.get(
+            node.url + path, params={"name": "no-such-process"}, timeout=10
+        )
+        assert resp.status_code == 404, (path, resp.status_code, resp.text)
+        assert "error" in resp.json()

@@ -257,6 +257,50 @@ def test_secagg_partial_roster_proceeds(node):
     _check_aggregation(node, name, params, results, 3)
 
 
+def test_secagg_masking_deadline_aggregates_when_sufficient(node):
+    """Cycle readiness never fires by count (max_diffs=4 with only 3
+    reports, no cycle deadline): the masking timeout must hand the cycle
+    to the unmask round — reported >= min_diffs means the deadline is
+    readiness, not failure — instead of discarding 3 valid reports."""
+    name = "secagg-mask-deadline"
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(3), (D, H, C))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(node.url)
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": name, "version": "1.0",
+            "batch_size": B, "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": N_WORKERS, "max_workers": N_WORKERS,
+            "min_diffs": THRESHOLD, "max_diffs": N_WORKERS, "num_cycles": 1,
+            "do_not_reuse_workers_until_cycle": 0,
+            "pool_selection": "random",
+            "secure_aggregation": {
+                "clip_range": CLIP, "threshold": THRESHOLD,
+                "phase_timeout": 15.0, "masking_timeout": 3.0,
+            },
+        },
+    )
+    assert resp.get("status") == "success", resp
+    mc.close()
+    results = _run_round(node, name, params, drop_idx=3)
+    assert results[3][0] == "dropped"
+    survivors = [r for i, r in results.items() if i != 3]
+    assert all(phase in ("done", "closed") for phase, _ in survivors)
+    _check_aggregation(node, name, params, results, N_WORKERS)
+
+
 def test_secagg_corrupt_share_fails_cycle_cleanly(node):
     """Two survivors submit garbage share material (two, so every
     threshold-size reconstruction subset contains at least one — a single
@@ -377,6 +421,11 @@ def test_secagg_host_rejects_bad_configs(node):
         {**base, "secure_aggregation": "yes"},
         {**base, "secure_aggregation": {"clip_range": 0.5}, "max_workers": 1,
          "min_workers": 1},
+        # sub-majority threshold (2 <= 4//2): disjoint t-quorums would let
+        # a malicious server collect both b_i and sk_i shares for a client
+        {**base, "min_workers": 4, "max_workers": 4, "min_diffs": 2,
+         "max_diffs": 4,
+         "secure_aggregation": {"clip_range": 0.5, "threshold": 2}},
     ):
         with pytest.raises(PyGridError):
             mc.host_federated_training(

@@ -313,3 +313,48 @@ def test_sim_end_to_end_round_matches_plain_mean():
     )
     step = 1.0 / secagg.choose_scale(0.1, K)
     np.testing.assert_allclose(out, diffs.mean(0), atol=K * step + 1e-8)
+
+# ── client-side threshold guard ──────────────────────────────────────────────
+
+
+def test_session_rejects_sub_majority_threshold():
+    """wait_roster must refuse a server-sent threshold <= n/2 — the
+    malicious-server guarantee needs an honest-majority quorum."""
+    from pygrid_tpu.client.secagg import SecAggSession
+
+    pubs = {f"w{i}": secagg.DHKeyPair.generate().public for i in range(4)}
+
+    class FakeClient:
+        def _send_event(self, msg_type, data):
+            return {
+                "data": {
+                    "status": "ready",
+                    "roster": {
+                        wid: secagg.int_to_hex(pub)
+                        for wid, pub in pubs.items()
+                    },
+                    "threshold": 2,  # 2 <= 4//2 — sub-majority
+                    "clip_range": 0.5,
+                }
+            }
+
+    session = SecAggSession(FakeClient(), "w0", "key")
+    with pytest.raises(PyGridError, match="sub-majority"):
+        session.wait_roster(timeout=1.0)
+
+
+def test_validate_host_config_rejects_sub_majority_threshold():
+    from pygrid_tpu.federated.secagg_service import SecAggService
+
+    base = {
+        "min_workers": 4, "max_workers": 4,
+        "min_diffs": 3, "max_diffs": 4,
+    }
+    with pytest.raises(PyGridError, match="roster/2"):
+        SecAggService.validate_host_config(
+            {**base, "secure_aggregation": {"clip_range": 0.5, "threshold": 2}}
+        )
+    # majority thresholds still pass
+    SecAggService.validate_host_config(
+        {**base, "secure_aggregation": {"clip_range": 0.5, "threshold": 3}}
+    )
