@@ -3,8 +3,9 @@
 The transport under every SDK client: JSON request/response with request_id
 correlation plus raw binary frames (the two frame kinds the Node's
 ``route_requests`` handles — reference ``events/__init__.py:61-107``).
-Built on ``websockets.sync`` (no asyncio in user code, mirroring the
-reference's blocking syft clients).
+Built on the in-repo blocking transport (``client.ws_transport`` — no
+asyncio, no background reader threads; mirroring the reference's blocking
+syft clients while avoiding per-message thread handoffs on busy hosts).
 """
 
 from __future__ import annotations
@@ -14,15 +15,8 @@ import threading
 import uuid
 from typing import Any
 
-from websockets.sync.client import connect
-
-from pygrid_tpu.native import install_ws_masking
+from pygrid_tpu.client.ws_transport import RawWSClient
 from pygrid_tpu.utils.codes import MSG_FIELD
-
-# client→server frames are masked; swap in the native XOR when websockets
-# would otherwise mask byte-by-byte in Python (the analog of the
-# reference's geventwebsocket masking patch, util.py:5-24)
-install_ws_masking()
 
 
 class GridWSClient:
@@ -37,20 +31,21 @@ class GridWSClient:
         self.timeout = timeout
         self._ws = None
         self._lock = threading.Lock()
+        self._req_prefix = uuid.uuid4().hex[:8]
+        self._req_seq = 0
 
     # ── connection ──────────────────────────────────────────────────────────
 
     def connect(self) -> "GridWSClient":
         if self._ws is None:
-            # permessage-deflate off: grid payloads are serde/base64 bytes
+            # no permessage-deflate: grid payloads are serde/base64 bytes
             # (high entropy), where zlib costs ~40x the loopback wire time
             # per MB and saves nothing — measured 128 ms vs 3.4 ms for a
-            # 1.66MB report frame
-            self._ws = connect(
-                self.ws_url,
-                open_timeout=self.timeout,
-                max_size=2**28,
-                compression=None,
+            # 1.66MB report frame. Frames mask through the native XOR
+            # kernel (the analog of the reference's masking patch,
+            # util.py:5-24).
+            self._ws = RawWSClient(
+                self.ws_url, open_timeout=self.timeout, max_size=2**28
             )
         return self
 
@@ -79,32 +74,101 @@ class GridWSClient:
         """One event round-trip: frame, send, then read frames of the
         matching kind until the request_id correlates (frames of the other
         kind on the same socket belong to other traffic)."""
-        self.connect()
-        request_id = uuid.uuid4().hex
-        message: dict[str, Any] = {
-            MSG_FIELD.TYPE: msg_type,
-            MSG_FIELD.REQUEST_ID: request_id,
-        }
-        if data is not None:
-            message[MSG_FIELD.DATA] = data
-        message.update(top_level)
+        # the lock covers connect + sequence + round trip: _ws and
+        # _req_seq are shared across calling threads
         with self._lock:
-            self._ws.send(encode(message))
-            while True:
-                raw = self._ws.recv(timeout=self.timeout)
-                if isinstance(raw, bytes) is not want_bytes:
-                    continue  # stray frame of the other kind: not ours
-                response = decode(raw)
-                if isinstance(response, dict) and response.get(
-                    MSG_FIELD.REQUEST_ID
-                ) in (None, request_id):
-                    return response
+            self.connect()
+            # unique per connection is all correlation needs (responses
+            # ride the same socket) — a counter beats per-request urandom
+            self._req_seq += 1
+            request_id = f"{self._req_prefix}-{self._req_seq}"
+            message: dict[str, Any] = {
+                MSG_FIELD.TYPE: msg_type,
+                MSG_FIELD.REQUEST_ID: request_id,
+            }
+            if data is not None:
+                message[MSG_FIELD.DATA] = data
+            message.update(top_level)
+            try:
+                self._ws.send(encode(message))
+                return self._recv_correlated(request_id, decode, want_bytes)
+            except (ConnectionError, TimeoutError, OSError):
+                self._drop_connection()
+                raise
+
+    def _recv_correlated(
+        self, request_id: str, decode: Any, want_bytes: bool
+    ) -> dict:
+        """Read frames of the matching kind until the request_id
+        correlates (frames of the other kind belong to other traffic).
+        Caller holds the lock and owns connection-drop on error."""
+        while True:
+            frame = self._ws.recv(timeout=self.timeout)
+            if isinstance(frame, bytes) is not want_bytes:
+                continue  # stray frame of the other kind: not ours
+            response = decode(frame)
+            if isinstance(response, dict) and response.get(
+                MSG_FIELD.REQUEST_ID
+            ) in (None, request_id):
+                return response
+
+    def _drop_connection(self) -> None:
+        """A transport error mid-round-trip leaves the stream position
+        unknown (e.g. a recv timeout after part of a frame was consumed)
+        — never reuse the socket; the next call reconnects."""
+        if self._ws is not None:
+            try:
+                self._ws.close()
+            except OSError:
+                pass
+            self._ws = None
 
     def send_json(self, msg_type: str, data: Any = None, **top_level) -> dict:
         """One JSON round-trip; request_id correlates the response."""
         return self._request(
             msg_type, data, top_level, json.dumps, json.loads, want_bytes=False
         )
+
+    def send_json_spliced(
+        self, msg_type: str, data: dict, raw_key: str, raw_value: bytes | str
+    ) -> dict:
+        """JSON round-trip with one large escape-free ASCII field spliced
+        into ``data`` after serialization — identical wire bytes to
+        :meth:`send_json`, but ``json.dumps`` never escape-scans the
+        megabyte payload (base64 contains no escapable characters), and a
+        ``bytes`` value (e.g. straight from ``b64encode``) skips the
+        str-decode/utf-8-encode round trip entirely. The FL report path
+        sends ~1.7 MB frames per cycle through this."""
+        with self._lock:
+            self.connect()
+            self._req_seq += 1
+            request_id = f"{self._req_prefix}-{self._req_seq}"
+            head = json.dumps(
+                {
+                    MSG_FIELD.TYPE: msg_type,
+                    MSG_FIELD.REQUEST_ID: request_id,
+                    MSG_FIELD.DATA: data,
+                }
+            )
+            assert head.endswith("}}")
+            sep = ", " if data else ""
+            payload = (
+                raw_value
+                if isinstance(raw_value, bytes)
+                else raw_value.encode()
+            )
+            frame = b"".join(
+                (head[:-2].encode(), f'{sep}"{raw_key}": "'.encode(),
+                 payload, b'"}}')
+            )
+            try:
+                self._ws.send_text_bytes(frame)
+                return self._recv_correlated(
+                    request_id, json.loads, want_bytes=False
+                )
+            except (ConnectionError, TimeoutError, OSError):
+                self._drop_connection()
+                raise
 
     def send_msg_binary(self, msg_type: str, data: Any = None, **top_level) -> dict:
         """One msgpack-framed event round-trip — the binary twin of
@@ -118,10 +182,14 @@ class GridWSClient:
 
     def send_binary(self, blob: bytes) -> bytes:
         """One binary round-trip (syft wire messages)."""
-        self.connect()
         with self._lock:
-            self._ws.send(blob)
-            while True:
-                raw = self._ws.recv(timeout=self.timeout)
-                if isinstance(raw, bytes):
-                    return raw
+            self.connect()
+            try:
+                self._ws.send(blob)
+                while True:
+                    frame = self._ws.recv(timeout=self.timeout)
+                    if isinstance(frame, bytes):
+                        return frame
+            except (ConnectionError, TimeoutError, OSError):
+                self._drop_connection()
+                raise

@@ -190,6 +190,12 @@ class FLClient:
         # top-k error-feedback residuals per (model, version), carried
         # across cycles
         self._residuals: dict[tuple, list] = {}
+        # keep-alive HTTP: checkpoint downloads happen once per cycle
+        # per worker — both fresh TCP connects and requests' per-call
+        # bookkeeping cost more than the transfer on loopback grids
+        from pygrid_tpu.client.ws_transport import KeepAliveHTTP
+
+        self._http = KeepAliveHTTP(self.address, timeout=timeout)
 
     def new_job(self, model_name: str, model_version: str | None = None) -> FLJob:
         return FLJob(self, model_name, model_version)
@@ -271,14 +277,10 @@ class FLClient:
         }
         if precision:
             params["precision"] = precision
-        resp = requests.get(
-            f"{self.address}/model-centric/get-model",
-            params=params,
-            timeout=60,
-        )
-        if resp.status_code != 200:
-            raise PyGridError(resp.text)
-        return unserialize_model_params(resp.content)
+        status, body = self._http.get("/model-centric/get-model", params)
+        if status != 200:
+            raise PyGridError(body.decode(errors="replace"))
+        return unserialize_model_params(body)
 
     def get_plan(
         self,
@@ -290,19 +292,18 @@ class FLClient:
         cached = self._plan_cache.get((plan_id, receive_operations_as))
         if cached is not None:
             return cached
-        resp = requests.get(
-            f"{self.address}/model-centric/get-plan",
-            params={
+        status, body = self._http.get(
+            "/model-centric/get-plan",
+            {
                 "worker_id": worker_id,
                 "request_key": request_key,
                 "plan_id": str(plan_id),
                 "receive_operations_as": receive_operations_as,
             },
-            timeout=60,
         )
-        if resp.status_code != 200:
-            raise PyGridError(resp.text)
-        plan = deserialize(resp.content)
+        if status != 200:
+            raise PyGridError(body.decode(errors="replace"))
+        plan = deserialize(body)
         self._plan_cache[(plan_id, receive_operations_as)] = plan
         return plan
 
@@ -333,20 +334,29 @@ class FLClient:
         return response.get(MSG_FIELD.DATA, response)
 
     def report(self, worker_id: str, request_key: str, diff_blob: bytes) -> dict:
-        diff: Any = (
-            diff_blob
-            if self.wire == "binary"
-            else base64.b64encode(diff_blob).decode()
-        )
-        response = self._send_event(
-            MODEL_CENTRIC_FL_EVENTS.REPORT,
-            data={
-                MSG_FIELD.WORKER_ID: worker_id,
-                CYCLE.KEY: request_key,
-                CYCLE.DIFF: diff,
-            },
-        )
+        if self.wire == "binary":
+            response = self._send_event(
+                MODEL_CENTRIC_FL_EVENTS.REPORT,
+                data={
+                    MSG_FIELD.WORKER_ID: worker_id,
+                    CYCLE.KEY: request_key,
+                    CYCLE.DIFF: diff_blob,
+                },
+            )
+        else:
+            # spliced framing: wire-identical to a plain JSON report, but
+            # the megabyte base64 field skips the dumps escape scan
+            response = self.ws.send_json_spliced(
+                MODEL_CENTRIC_FL_EVENTS.REPORT,
+                data={
+                    MSG_FIELD.WORKER_ID: worker_id,
+                    CYCLE.KEY: request_key,
+                },
+                raw_key=CYCLE.DIFF,
+                raw_value=base64.b64encode(diff_blob),
+            )
         return response.get(MSG_FIELD.DATA, response)
 
     def close(self) -> None:
         self.ws.close()
+        self._http.close()

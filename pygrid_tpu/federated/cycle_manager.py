@@ -33,6 +33,7 @@ from pygrid_tpu.federated import tasks
 from pygrid_tpu.federated.compression import decode_diff
 from pygrid_tpu.federated.managers import ModelManager, PlanManager, ProcessManager
 from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
+from pygrid_tpu.serde.wire import state_raw_tensors
 from pygrid_tpu.storage.warehouse import Database, Warehouse
 from pygrid_tpu.utils import exceptions as E
 
@@ -61,8 +62,37 @@ class _DiffAccumulator:
                 np.asarray(t, dtype=np.float64) * weight for t in diff
             ]
         else:
+            from pygrid_tpu.native import accum_f32
+
             for s, t in zip(self.sums, diff):
-                s += np.asarray(t, dtype=np.float64) * weight
+                t = np.asarray(t)
+                if t.dtype == np.float32:
+                    # native one-pass fold (numpy cast-add fallback): no
+                    # f64 temp the size of the diff (~19 ms/report saved
+                    # for the MNIST MLP)
+                    accum_f32(s, t, weight)
+                elif weight == 1.0:
+                    np.add(s, t, out=s)
+                else:
+                    s += np.multiply(t, weight, dtype=np.float64)
+        self.count += 1
+        self.weight_sum += weight
+
+    def add_raw(self, raws: list, weight: float = 1.0) -> None:
+        """Fold tensors still in wire form (``serde.RawTensor``) — the
+        native one-pass accumulate; bf16 payloads fold without ever
+        materializing as float32. Caller validated kinds/shapes."""
+        from pygrid_tpu.native import accum_bf16, accum_f32
+
+        if self.sums is None:
+            self.sums = [
+                np.zeros(rt.shape, dtype=np.float64) for rt in raws
+            ]
+        for s, rt in zip(self.sums, raws):
+            if rt.kind == "bf16":
+                accum_bf16(s, rt.raw, weight)
+            else:
+                accum_f32(s, rt.raw, weight)
         self.count += 1
         self.weight_sum += weight
 
@@ -174,7 +204,9 @@ class CycleManager:
     def last_participation(self, fl_process_id: int, worker_id: str) -> int:
         """Highest completed-cycle sequence this worker contributed to."""
         last = 0
-        for wc in self._worker_cycles.query(worker_id=worker_id, is_completed=True):
+        for wc in self._worker_cycles.query(
+            worker_id=worker_id, is_completed=True, columns=("cycle_id",)
+        ):
             cycle = self._cycles.first(id=wc.cycle_id)
             if cycle and cycle.fl_process_id == fl_process_id:
                 last = max(last, cycle.sequence)
@@ -205,7 +237,7 @@ class CycleManager:
         cycle must block a new one or a worker could hold several live
         keys and stack contributions in a single buffer."""
         for wc in self._worker_cycles.query(
-            worker_id=worker_id, is_completed=False
+            worker_id=worker_id, is_completed=False, columns=("cycle_id",)
         ):
             cycle = self._cycles.first(id=wc.cycle_id)
             if cycle is not None and cycle.fl_process_id == fl_process_id:
@@ -226,7 +258,13 @@ class CycleManager:
 
     def validate(self, worker_id: str, cycle_id: int, request_key: str) -> S.WorkerCycle:
         wc = self._worker_cycles.first(
-            worker_id=worker_id, cycle_id=cycle_id, request_key=request_key
+            worker_id=worker_id,
+            cycle_id=cycle_id,
+            request_key=request_key,
+            columns=(
+                "id", "cycle_id", "worker_id", "request_key",
+                "is_completed", "assigned_checkpoint",
+            ),
         )
         if wc is None:
             raise E.InvalidRequestKeyError()
@@ -243,7 +281,12 @@ class CycleManager:
         cycle already flushed: a stale report re-homes to the current
         buffer instead of bouncing."""
         for candidate in self._worker_cycles.query(
-            worker_id=worker_id, request_key=request_key
+            worker_id=worker_id,
+            request_key=request_key,
+            columns=(
+                "id", "cycle_id", "worker_id", "request_key",
+                "is_completed", "assigned_checkpoint",
+            ),
         ):
             cycle = self._cycles.first(
                 id=candidate.cycle_id, is_completed=False
@@ -305,7 +348,32 @@ class CycleManager:
         # that counts toward readiness and re-raises on every completion
         # attempt (a wrong-shaped diff is just as poisonous — zip() in the
         # accumulator would silently truncate)
-        decoded = self._decode_and_check(diff, cycle.fl_process_id)
+        pid = cycle.fl_process_id
+        raws = None
+        if (
+            self._uses_fallback_mean(pid)
+            and self._robust_config(pid) is None
+            and self._dp_config(pid) is None
+        ):
+            # fast ingest: plain dense State + plain mean → validate from
+            # the wire headers and fold the raw buffers natively; anything
+            # else (sparse envelope, odd dtype, malformed bytes) falls
+            # through to the full decode door, which owns error reporting
+            raws = state_raw_tensors(diff)
+            if raws is not None:
+                if any(rt.kind not in ("<f4", "bf16") for rt in raws):
+                    raws = None
+                else:
+                    expected = self._model_shapes(pid)
+                    got = [rt.shape for rt in raws]
+                    if got != expected:
+                        raise E.PyGridError(
+                            f"diff shapes {got} do not match model "
+                            f"shapes {expected}"
+                        )
+        decoded = (
+            self._decode_and_check(diff, pid) if raws is None else None
+        )
         self._worker_cycles.modify(
             {"id": wc.id},
             {
@@ -324,18 +392,24 @@ class CycleManager:
             # mean need every diff separately at completion.
             # Decode happened outside the lock: only the cheap fold
             # serializes.
-            dp = self._dp_config(cycle.fl_process_id)
-            if dp:
-                # clip at ingest: the accumulator only ever holds bounded
-                # per-client contributions (DP-FedAvg, federated/privacy.py;
-                # DP + custom avg plan is rejected at host time, so the
-                # fallback path is the only aggregation door under DP)
-                from pygrid_tpu.federated.privacy import clip_diff
+            if raws is not None:
+                with self._accum_lock:
+                    acc = self._accum.setdefault(cycle.id, _DiffAccumulator())
+                    acc.add_raw(raws)
+            else:
+                dp = self._dp_config(cycle.fl_process_id)
+                if dp:
+                    # clip at ingest: the accumulator only ever holds
+                    # bounded per-client contributions (DP-FedAvg,
+                    # federated/privacy.py; DP + custom avg plan is
+                    # rejected at host time, so the fallback path is the
+                    # only aggregation door under DP)
+                    from pygrid_tpu.federated.privacy import clip_diff
 
-                decoded = clip_diff(decoded, float(dp["clip_norm"]))
-            with self._accum_lock:
-                acc = self._accum.setdefault(cycle.id, _DiffAccumulator())
-                acc.add(decoded)
+                    decoded = clip_diff(decoded, float(dp["clip_norm"]))
+                with self._accum_lock:
+                    acc = self._accum.setdefault(cycle.id, _DiffAccumulator())
+                    acc.add(decoded)
             fresh = self._cycles.first(id=cycle.id)
             if fresh is not None and fresh.is_completed:
                 # lost the race with completion (it rebuilt from blobs);
@@ -402,7 +476,9 @@ class CycleManager:
         totals: dict[str, float] = {}
         weights: dict[str, float] = {}
         n_reports = 0
-        for wc in self._worker_cycles.query(cycle_id=cycle_id):
+        for wc in self._worker_cycles.query(
+            cycle_id=cycle_id, columns=("metrics",)
+        ):
             if not wc.metrics:
                 continue
             m = deserialize(wc.metrics)
@@ -583,7 +659,9 @@ class CycleManager:
     def _received_diffs(self, cycle_id: int) -> list[bytes]:
         return [
             wc.diff
-            for wc in self._worker_cycles.query(cycle_id=cycle_id, is_completed=True)
+            for wc in self._worker_cycles.query(
+                cycle_id=cycle_id, is_completed=True, columns=("diff",)
+            )
             if wc.diff
         ]
 
@@ -750,10 +828,14 @@ class CycleManager:
                 # accumulator — rebuild it from the stored blobs.
                 with self._accum_lock:
                     acc = self._accum.pop(cycle.id, None)
-                received = self._received_diffs(cycle.id)
-                if acc is None or acc.count != len(received):
+                # count by SQL, not by loading every stored blob — the
+                # blobs only load on the restart-recovery rebuild below
+                n_received = self._worker_cycles.count(
+                    cycle_id=cycle.id, is_completed=True
+                )
+                if acc is None or acc.count != n_received:
                     acc = _DiffAccumulator()
-                    for d in received:
+                    for d in self._received_diffs(cycle.id):
                         acc.add(_decode(d))
                 n_diffs = acc.count  # the mean's actual divisor — a late
                 # racing report must scale the noise it is averaged under

@@ -111,6 +111,11 @@ class ProtocolManager:
 
 
 class ProcessManager:
+    """FLProcess rows, configs, and the plan/protocol id maps are all
+    immutable once hosted — the protocol hot paths (authenticate,
+    cycle-request, report: several lookups per message) serve them from
+    in-memory caches invalidated only by create/delete."""
+
     def __init__(
         self, db: Database, plan_manager: PlanManager, protocol_manager: ProtocolManager
     ) -> None:
@@ -118,6 +123,14 @@ class ProcessManager:
         self._configs = Warehouse(S.Config, db)
         self.plan_manager = plan_manager
         self.protocol_manager = protocol_manager
+        self._row_cache: dict[tuple, S.FLProcess] = {}
+        self._config_cache: dict[tuple[int, bool], dict] = {}
+        self._assets_cache: dict[tuple, dict] = {}
+
+    def _invalidate(self) -> None:
+        self._row_cache.clear()
+        self._config_cache.clear()
+        self._assets_cache.clear()
 
     def count(self, **filters: Any) -> int:
         return self._processes.count(**filters)
@@ -151,29 +164,49 @@ class ProcessManager:
         return process
 
     def first(self, **filters: Any) -> S.FLProcess:
-        process = self._processes.first(**filters)
+        key = tuple(sorted(filters.items()))
+        process = self._row_cache.get(key)
         if process is None:
-            raise E.FLProcessNotFoundError()
+            process = self._processes.first(**filters)
+            if process is None:
+                raise E.FLProcessNotFoundError()
+            self._row_cache[key] = process
         return process
 
     def get(self, **filters: Any) -> list[S.FLProcess]:
         return self._processes.query(**filters)
 
     def get_configs(self, fl_process_id: int, is_server_config: bool) -> dict:
-        cfg = self._configs.first(
-            fl_process_id=fl_process_id, is_server_config=is_server_config
-        )
-        if cfg is None:
-            raise E.ConfigsNotFoundError()
-        return cfg.config
+        key = (int(fl_process_id), bool(is_server_config))
+        config = self._config_cache.get(key)
+        if config is None:
+            cfg = self._configs.first(
+                fl_process_id=fl_process_id, is_server_config=is_server_config
+            )
+            if cfg is None:
+                raise E.ConfigsNotFoundError()
+            config = self._config_cache[key] = cfg.config
+        return config
 
     def get_plans(self, fl_process_id: int, is_avg_plan: bool = False) -> dict:
-        return self.plan_manager.get_plans(
-            fl_process_id=fl_process_id, is_avg_plan=is_avg_plan
-        )
+        key = ("plans", int(fl_process_id), bool(is_avg_plan))
+        plans = self._assets_cache.get(key)
+        if plans is None:
+            plans = self._assets_cache[key] = self.plan_manager.get_plans(
+                fl_process_id=fl_process_id, is_avg_plan=is_avg_plan
+            )
+        return plans
 
     def get_protocols(self, fl_process_id: int) -> dict:
-        return self.protocol_manager.get_protocols(fl_process_id=fl_process_id)
+        key = ("protocols", int(fl_process_id))
+        protocols = self._assets_cache.get(key)
+        if protocols is None:
+            protocols = self._assets_cache[key] = (
+                self.protocol_manager.get_protocols(
+                    fl_process_id=fl_process_id
+                )
+            )
+        return protocols
 
     def delete(self, **filters: Any) -> None:
         for process in self._processes.query(**filters):
@@ -181,6 +214,7 @@ class ProcessManager:
             self.protocol_manager.delete(fl_process_id=process.id)
             self._configs.delete(fl_process_id=process.id)
         self._processes.delete(**filters)
+        self._invalidate()
 
 
 class ModelManager:
@@ -195,6 +229,7 @@ class ModelManager:
         self._blob_cache: dict[tuple[int, str], tuple[int, bytes]] = {}
         self._blob_lock = threading.Lock()
         self._latest_ckpt: dict[int, int] = {}
+        self._model_row_cache: dict[tuple, S.Model] = {}
 
     def create(self, model_params_blob: bytes, process: S.FLProcess) -> S.Model:
         model = self._models.register(
@@ -204,9 +239,15 @@ class ModelManager:
         return model
 
     def get(self, **filters: Any) -> S.Model:
-        model = self._models.first(**filters)
+        # model rows are immutable (id/version/process fixed at hosting);
+        # the request paths look one up per download/report
+        key = tuple(sorted(filters.items()))
+        model = self._model_row_cache.get(key)
         if model is None:
-            raise E.ModelNotFoundError()
+            model = self._models.first(**filters)
+            if model is None:
+                raise E.ModelNotFoundError()
+            self._model_row_cache[key] = model
         return model
 
     def save(self, model_id: int, blob: bytes) -> S.ModelCheckPoint:

@@ -10,10 +10,71 @@ app which supplies its own executor).
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 from typing import Any, Callable
 
 logger = logging.getLogger(__name__)
+
+
+class _DaemonPool:
+    """Elastic daemon worker threads (instead of a fresh thread per
+    trigger — the report path triggers a readiness check per diff, and
+    thread spawn costs more than the check itself). Daemon matters: a
+    task wedged on a dead device tunnel must not block interpreter exit
+    the way concurrent.futures' atexit join would. Elastic matters: when
+    every worker is busy (or wedged), a new submission grows the pool up
+    to MAX_WORKERS so slow tasks cannot starve every other FL process's
+    readiness checks."""
+
+    MAX_WORKERS = 32
+
+    def __init__(self, workers: int = 4) -> None:
+        self._q: queue.Queue[Callable[[], None]] = queue.Queue()
+        self._idle = 0
+        self._n = 0
+        self._grow_lock = threading.Lock()
+        for _ in range(workers):
+            self._spawn()
+
+    def _spawn(self) -> None:
+        self._n += 1
+        threading.Thread(
+            target=self._loop, name=f"task-{self._n}", daemon=True
+        ).start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._grow_lock:
+                self._idle += 1
+            try:
+                job = self._q.get()
+            finally:
+                with self._grow_lock:
+                    self._idle -= 1
+            try:
+                job()
+            except Exception:  # noqa: BLE001 — background boundary
+                logger.exception("background task failed")
+
+    def submit(self, job: Callable[[], None]) -> None:
+        with self._grow_lock:
+            if self._idle == 0 and self._n < self.MAX_WORKERS:
+                self._spawn()
+        self._q.put(job)
+
+
+_pool: _DaemonPool | None = None
+_pool_lock = threading.Lock()
+
+
+def _executor() -> _DaemonPool:
+    global _pool
+    if _pool is None:
+        with _pool_lock:
+            if _pool is None:
+                _pool = _DaemonPool()
+    return _pool
 
 # key -> {"status": "running" | "rerun", "call": (fn, args)}. A trigger that
 # arrives while running must not be dropped: the running pass may have read
@@ -56,4 +117,4 @@ def run_task_once(key: str, fn: Callable, *args: Any) -> None:
     if _sync:
         _run()
     else:
-        threading.Thread(target=_run, name=f"task-{key}", daemon=True).start()
+        _executor().submit(_run)

@@ -43,7 +43,8 @@ async def ws_handler(request: web.Request) -> web.StreamResponse:
             if msg.type == WSMsgType.TEXT:
                 payload: str | bytes = msg.data
             elif msg.type == WSMsgType.BINARY:
-                payload = bytearray(msg.data)
+                payload = msg.data  # already bytes — no defensive copy on
+                # the megabyte report path; handlers never mutate frames
             else:
                 continue
             response = await loop.run_in_executor(

@@ -1,7 +1,9 @@
 from pygrid_tpu.serde.wire import (  # noqa: F401
+    RawTensor,
     deserialize,
     from_hex,
     register_serde,
     serialize,
+    state_raw_tensors,
     to_hex,
 )

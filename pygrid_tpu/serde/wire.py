@@ -85,9 +85,10 @@ def _unpack_ndarray(payload: bytes) -> np.ndarray:
 def _pack_ndarray_bf16(arr: np.ndarray) -> msgpack.ExtType:
     from pygrid_tpu.native import f32_to_bf16
 
+    shape = list(np.shape(arr))  # before ascontiguousarray: 0-d promotes
     arr = np.ascontiguousarray(arr, dtype=np.float32)
     payload = msgpack.packb(
-        [list(arr.shape), f32_to_bf16(arr).tobytes()], use_bin_type=True
+        [shape, f32_to_bf16(arr).tobytes()], use_bin_type=True
     )
     return msgpack.ExtType(EXT_NDARRAY_BF16, payload)
 
@@ -198,9 +199,235 @@ def serialize(obj: Any, *, bf16_floats: bool = False) -> bytes:
 
 
 def deserialize(blob: bytes | bytearray | memoryview) -> Any:
+    if not isinstance(blob, bytes):
+        blob = bytes(blob)  # msgpack keeps no reference past unpackb, but
+        # normalize non-bytes views; the common (bytes) case is zero-copy
     return msgpack.unpackb(
-        bytes(blob), raw=False, ext_hook=_ext_hook, strict_map_key=False
+        blob, raw=False, ext_hook=_ext_hook, strict_map_key=False
     )
+
+
+class RawTensor:
+    """A tensor still in wire form: dtype tag, shape, and the raw payload
+    buffer — no array materialization. The FL report fold accumulates
+    straight from these (``native.accum_f32``/``accum_bf16``), skipping
+    the frombuffer/astype copies of a full decode."""
+
+    __slots__ = ("kind", "shape", "raw")
+
+    def __init__(self, kind: str, shape: tuple, raw: bytes) -> None:
+        self.kind = kind          # numpy dtype str, or "bf16"
+        self.shape = shape
+        self.raw = raw
+
+    @property
+    def nelems(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= int(s)
+        return n
+
+    def itemsize(self) -> int:
+        return 2 if self.kind == "bf16" else np.dtype(self.kind).itemsize
+
+
+class _NotPlainState(Exception):
+    pass
+
+
+def _raw_ext_hook(code: int, payload: bytes):
+    if code == EXT_NDARRAY:
+        dtype_str, shape, raw = msgpack.unpackb(payload, raw=False)
+        return RawTensor(dtype_str, tuple(shape), raw)
+    if code == EXT_NDARRAY_BF16:
+        shape, raw = msgpack.unpackb(payload, raw=False)
+        return RawTensor("bf16", tuple(shape), raw)
+    if code == EXT_OBJECT:
+        unpacker = msgpack.Unpacker(
+            raw=False, ext_hook=_raw_ext_hook, strict_map_key=False
+        )
+        unpacker.feed(payload)
+        type_name = unpacker.unpack()
+        if type_name not in ("pygrid.State", "pygrid.PlaceHolder"):
+            raise _NotPlainState(type_name)
+        return {"__wire_type": type_name, "data": unpacker.unpack()}
+    raise _NotPlainState(f"ext code {code}")
+
+
+class _Cursor:
+    """Minimal msgpack reader over a memoryview — no payload copies. Only
+    the token types the State envelope uses; anything else raises and the
+    caller falls back to the general parser."""
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: memoryview) -> None:
+        self.buf = buf
+        self.pos = 0
+
+    def _take(self, n: int) -> memoryview:
+        out = self.buf[self.pos: self.pos + n]
+        if len(out) != n:
+            raise ValueError("truncated")
+        self.pos += n
+        return out
+
+    def _u(self, n: int) -> int:
+        return int.from_bytes(self._take(n), "big")
+
+    def read(self):  # noqa: C901 — one flat token switch
+        b = self._u(1)
+        if b <= 0x7F:
+            return b                                   # positive fixint
+        if 0x80 <= b <= 0x8F:
+            return self._map(b & 0x0F)
+        if 0x90 <= b <= 0x9F:
+            return self._array(b & 0x0F)
+        if 0xA0 <= b <= 0xBF:
+            return str(self._take(b & 0x1F), "utf-8")  # fixstr
+        if b == 0xC0:
+            return None
+        if b == 0xC2:
+            return False
+        if b == 0xC3:
+            return True
+        if b == 0xC4:
+            return self._take(self._u(1))              # bin8 → memoryview
+        if b == 0xC5:
+            return self._take(self._u(2))
+        if b == 0xC6:
+            return self._take(self._u(4))
+        if b == 0xC7:                                  # ext8
+            n = self._u(1)
+            return (self._u(1), self._take(n))
+        if b == 0xC8:
+            n = self._u(2)
+            return (self._u(1), self._take(n))
+        if b == 0xC9:
+            n = self._u(4)
+            return (self._u(1), self._take(n))
+        if b == 0xCC:
+            return self._u(1)
+        if b == 0xCD:
+            return self._u(2)
+        if b == 0xCE:
+            return self._u(4)
+        if b == 0xCF:
+            return self._u(8)
+        if b == 0xD9:
+            return str(self._take(self._u(1)), "utf-8")
+        if b == 0xDA:
+            return str(self._take(self._u(2)), "utf-8")
+        if 0xD4 <= b <= 0xD8:                          # fixext 1/2/4/8/16
+            n = 1 << (b - 0xD4)
+            return (self._u(1), self._take(n))
+        if b == 0xDC:
+            return self._array(self._u(2))
+        if b == 0xDD:
+            return self._array(self._u(4))
+        if b == 0xDE:
+            return self._map(self._u(2))
+        if b >= 0xE0:
+            return b - 0x100                           # negative fixint
+        raise ValueError(f"unsupported msgpack token {b:#x}")
+
+    def _array(self, n: int) -> list:
+        return [self.read() for _ in range(n)]
+
+    def _map(self, n: int) -> dict:
+        return {self.read(): self.read() for _ in range(n)}
+
+
+def _cursor_state(blob) -> list[RawTensor] | None:
+    """Zero-copy walk of a dense-State wire blob: RawTensor.raw values are
+    memoryview slices of the caller's buffer (which must stay alive)."""
+    top = _Cursor(memoryview(blob).cast("B")).read()
+    out: list[RawTensor] = []
+    for ph_code, ph_payload in _expect_obj(top, "pygrid.State")[
+        "placeholders"
+    ]:
+        if ph_code != EXT_OBJECT:
+            return None
+        ph = _Cursor(ph_payload)
+        if ph.read() != "pygrid.PlaceHolder":
+            return None
+        tensor = ph.read().get("tensor")
+        if not isinstance(tensor, tuple):
+            return None
+        code, payload = tensor
+        cur = _Cursor(payload)
+        if code == EXT_NDARRAY:
+            dtype_str, shape, raw = cur.read()
+        elif code == EXT_NDARRAY_BF16:
+            dtype_str = "bf16"
+            shape, raw = cur.read()
+        else:
+            return None
+        if not isinstance(raw, memoryview):
+            return None
+        out.append(RawTensor(dtype_str, tuple(shape), raw))
+    return out
+
+
+def _expect_obj(token, type_name: str) -> dict:
+    if not (isinstance(token, tuple) and token[0] == EXT_OBJECT):
+        raise ValueError("not a wire object")
+    cur = _Cursor(token[1])
+    if cur.read() != type_name:
+        raise ValueError(f"not a {type_name}")
+    data = cur.read()
+    if not isinstance(data, dict):
+        raise ValueError("malformed wire object")
+    return data
+
+
+def state_raw_tensors(blob: bytes | bytearray) -> list[RawTensor] | None:
+    """Parse a State wire blob into its tensors' raw wire buffers WITHOUT
+    materializing arrays — the report-ingest fast path. Returns None when
+    the blob is not a plain dense State (sparse envelopes, wrapped
+    tensors, other objects, malformed bytes): callers then take the full
+    :func:`deserialize` door, which owns error reporting.
+
+    The fast path is a hand-rolled zero-copy cursor (tensor buffers are
+    memoryview slices of ``blob``); the general ext-hook parse is the
+    fallback for envelopes the cursor doesn't recognize."""
+    try:
+        out = _cursor_state(blob)
+        if out is not None:
+            for rt in out:
+                if len(rt.raw) != rt.nelems * rt.itemsize():
+                    return None  # inconsistent header → full decode raises
+            return out
+    except Exception:  # noqa: BLE001 — fall through to the general parse
+        pass
+    try:
+        obj = msgpack.unpackb(
+            blob, raw=False, ext_hook=_raw_ext_hook,
+            strict_map_key=False,
+        )
+    except Exception:  # noqa: BLE001 — malformed → full decode path
+        return None
+    try:
+        if not (
+            isinstance(obj, dict) and obj.get("__wire_type") == "pygrid.State"
+        ):
+            return None
+        out: list[RawTensor] = []
+        for ph in obj["data"].get("placeholders", ()):
+            if not (
+                isinstance(ph, dict)
+                and ph.get("__wire_type") == "pygrid.PlaceHolder"
+            ):
+                return None
+            tensor = ph["data"].get("tensor")
+            if not isinstance(tensor, RawTensor):
+                return None
+            if len(tensor.raw) != tensor.nelems * tensor.itemsize():
+                return None  # inconsistent header → full decode raises
+            out.append(tensor)
+        return out
+    except Exception:  # noqa: BLE001 — hostile headers → full decode path
+        return None
 
 
 def to_hex(obj: Any) -> str:

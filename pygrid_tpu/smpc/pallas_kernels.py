@@ -106,40 +106,61 @@ def _pad2(x: jax.Array, rows: int, cols: int) -> jax.Array:
     return x
 
 
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def pallas_ring_matmul(a: Ring64, b: Ring64, interpret: bool = False) -> Ring64:
-    """Exact ``a [M,K] @ b [K,N]`` over Z_2^64, one fused Pallas launch.
+    """Exact ``a [M,K] @ b [K,N]`` over Z_2^64, one fused Pallas launch;
+    batched ``[B,M,K] @ [B,K,N]`` operands vmap over the same kernel
+    (pallas_call's batching rule turns the batch into a leading grid
+    axis — the path ``smpc.kernels.batched_beaver`` drives).
 
-    Zero-padding to tile multiples is exact (zero limbs contribute
-    nothing). ``interpret=True`` runs the same kernel on CPU for tests."""
+    Tiles adapt downward for small operands: a 64×64 Beaver matmul under
+    the fixed 128×128×256 tiling would spend ~8× its FLOPs multiplying
+    zero padding (M, N and K each round up); only the lane dimension (N)
+    is pinned to 128 by the hardware. Zero-padding stays exact (zero
+    limbs contribute nothing). ``interpret=True`` runs the same kernel on
+    CPU for tests."""
+    if a.lo.ndim == 3 and b.lo.ndim == 3:
+        if a.lo.shape[0] != b.lo.shape[0]:
+            raise ValueError(
+                f"batch mismatch: {a.lo.shape} @ {b.lo.shape}"
+            )
+        return jax.vmap(lambda x, y: pallas_ring_matmul(x, y, interpret))(
+            a, b
+        )
     if a.lo.ndim != 2 or b.lo.ndim != 2:
-        raise ValueError("pallas_ring_matmul takes 2-D operands")
+        raise ValueError("pallas_ring_matmul takes 2-D or 3-D operands")
     M, K = a.lo.shape
     K2, N = b.lo.shape
     if K != K2:
         raise ValueError(f"contraction mismatch: {a.lo.shape} @ {b.lo.shape}")
-    Mp = pl.cdiv(M, TILE_M) * TILE_M
+    tile_m = min(TILE_M, _round_up(M, 8))     # sublane multiple
+    chunk_k = min(CHUNK_K, _round_up(K, 128))  # MXU contraction lanes
+    Mp = pl.cdiv(M, tile_m) * tile_m
     Np = pl.cdiv(N, TILE_N) * TILE_N
-    Kp = pl.cdiv(K, CHUNK_K) * CHUNK_K
+    Kp = pl.cdiv(K, chunk_k) * chunk_k
     a_lo, a_hi = _pad2(a.lo, Mp, Kp), _pad2(a.hi, Mp, Kp)
     b_lo, b_hi = _pad2(b.lo, Kp, Np), _pad2(b.hi, Kp, Np)
 
     a_spec = pl.BlockSpec(
-        (TILE_M, CHUNK_K), lambda mi, ni, ki: (mi, ki),
+        (tile_m, chunk_k), lambda mi, ni, ki: (mi, ki),
         memory_space=pltpu.VMEM,
     )
     b_spec = pl.BlockSpec(
-        (CHUNK_K, TILE_N), lambda mi, ni, ki: (ki, ni),
+        (chunk_k, TILE_N), lambda mi, ni, ki: (ki, ni),
         memory_space=pltpu.VMEM,
     )
     o_spec = pl.BlockSpec(
-        (TILE_M, TILE_N), lambda mi, ni, ki: (mi, ni),
+        (tile_m, TILE_N), lambda mi, ni, ki: (mi, ni),
         memory_space=pltpu.VMEM,
     )
     out_shape = jax.ShapeDtypeStruct((Mp, Np), jnp.uint32)
     lo, hi = pl.pallas_call(
         _matmul_kernel,
-        grid=(Mp // TILE_M, Np // TILE_N, Kp // CHUNK_K),
+        grid=(Mp // tile_m, Np // TILE_N, Kp // chunk_k),
         in_specs=[a_spec, a_spec, b_spec, b_spec],
         out_specs=[o_spec, o_spec],
         out_shape=[out_shape, out_shape],

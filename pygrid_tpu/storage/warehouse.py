@@ -18,7 +18,7 @@ import dataclasses
 import datetime as dt
 import sqlite3
 import threading
-from typing import Any, Iterator, Type, TypeVar
+from typing import Any, Iterator, Sequence, Type, TypeVar
 
 from pygrid_tpu.serde import deserialize, serialize
 
@@ -169,10 +169,15 @@ class Database:
             conn.close()
 
     def execute(self, sql: str, params: tuple = ()) -> "_Result":
+        # SELECTs never open a write transaction (autocommit mode), so the
+        # commit would be a no-op round trip — skipped; the protocol hot
+        # paths run several point reads per message
+        is_read = sql.lstrip()[:6].upper() == "SELECT"
         if self._is_memory:
             with self._lock:
                 cur = self._conn.execute(sql, params)
-                self._conn.commit()
+                if not is_read:
+                    self._conn.commit()
                 return _Result(cur.fetchall() if cur.description else [], cur.lastrowid)
         with self._connection() as conn:
             # materialize before the connection returns to the pool —
@@ -180,7 +185,8 @@ class Database:
             cur = conn.execute(sql, params)
             rows = cur.fetchall() if cur.description else []
             lastrowid = cur.lastrowid
-            conn.commit()
+            if not is_read:
+                conn.commit()
             return _Result(rows, lastrowid)
 
     def close(self) -> None:
@@ -314,26 +320,52 @@ class Warehouse:
         }
         return self.schema(**kwargs)
 
-    def query(self, order_by: str | None = None, **filters: Any) -> list[T]:
+    def _select(self, columns=None) -> str:
+        """Column projection: rows materialize with only the named fields
+        (the rest keep their dataclass defaults). Metadata scans over
+        tables with megabyte blob columns (WorkerCycle.diff,
+        ModelCheckPoint.value) must not drag the blobs through sqlite —
+        the hot FL report path queries per report."""
+        if not columns:
+            return "*"
+        valid = {f.name for f in self.fields}
+        unknown = set(columns) - valid
+        if unknown:
+            raise KeyError(f"unknown column(s) {sorted(unknown)}")
+        return ", ".join(f'"{c}"' for c in columns)
+
+    def query(
+        self,
+        order_by: str | None = None,
+        columns: Sequence[str] | None = None,
+        **filters: Any,
+    ) -> list[T]:
         where, params = self._where(filters)
         order = f' ORDER BY "{order_by}"' if order_by else ""
         cur = self.db.execute(
-            f"SELECT * FROM {self.table}{where}{order}", params
+            f"SELECT {self._select(columns)} FROM {self.table}{where}{order}",
+            params,
         )
         return [self._row_to_obj(r) for r in cur.fetchall()]
 
-    def first(self, **filters: Any) -> T | None:
+    def first(
+        self, columns: Sequence[str] | None = None, **filters: Any
+    ) -> T | None:
         where, params = self._where(filters)
         cur = self.db.execute(
-            f"SELECT * FROM {self.table}{where} LIMIT 1", params
+            f"SELECT {self._select(columns)} FROM {self.table}{where} LIMIT 1",
+            params,
         )
         row = cur.fetchone()
         return self._row_to_obj(row) if row else None
 
-    def last(self, **filters: Any) -> T | None:
+    def last(
+        self, columns: Sequence[str] | None = None, **filters: Any
+    ) -> T | None:
         where, params = self._where(filters)
         cur = self.db.execute(
-            f"SELECT * FROM {self.table}{where} ORDER BY rowid DESC LIMIT 1",
+            f"SELECT {self._select(columns)} FROM {self.table}{where} "
+            f"ORDER BY rowid DESC LIMIT 1",
             params,
         )
         row = cur.fetchone()

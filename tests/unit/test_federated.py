@@ -15,6 +15,7 @@ from pygrid_tpu.federated import schemas as S
 from pygrid_tpu.plans import Plan
 from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
 from pygrid_tpu.storage import Database
+from pygrid_tpu.utils import exceptions as E
 from pygrid_tpu.utils.codes import CYCLE
 from pygrid_tpu.utils.exceptions import (
     AuthorizationError,
@@ -544,3 +545,63 @@ def test_deadline_with_zero_diffs_closes_cycle_without_checkpoint():
     model = ctl.model_manager.get(fl_process_id=1)
     assert ctl.model_manager.load(model_id=model.id, alias="latest").number == 1
     assert ctl.cycle_manager.last(1).sequence == 2
+
+
+def test_add_raw_matches_add_exactly():
+    """The wire-buffer fold (add_raw, native kernels) and the decoded
+    fold (add) must produce bit-identical sums — they are the same f64
+    accumulation in different plumbing."""
+    from pygrid_tpu.federated.cycle_manager import _DiffAccumulator
+    from pygrid_tpu.serde import state_raw_tensors
+
+    rng = np.random.RandomState(11)
+    diffs = [
+        [rng.randn(37, 5).astype(np.float32), rng.randn(5).astype(np.float32)]
+        for _ in range(4)
+    ]
+    a_dec, a_raw = _DiffAccumulator(), _DiffAccumulator()
+    for d in diffs:
+        a_dec.add(d)
+        raws = state_raw_tensors(serialize_model_params(d))
+        assert raws is not None
+        a_raw.add_raw(raws)
+    for s_dec, s_raw in zip(a_dec.sums, a_raw.sums):
+        np.testing.assert_array_equal(s_dec, s_raw)
+    # bf16 wire: add_raw folds the bf16 bits; equal to decoding then adding
+    from pygrid_tpu.native import bf16_to_f32, f32_to_bf16
+
+    a_bf_dec, a_bf_raw = _DiffAccumulator(), _DiffAccumulator()
+    for d in diffs:
+        decoded = [bf16_to_f32(f32_to_bf16(t)).reshape(t.shape) for t in d]
+        a_bf_dec.add(decoded, weight=0.5)
+        raws = state_raw_tensors(serialize_model_params(d, bf16=True))
+        a_bf_raw.add_raw(raws, weight=0.5)
+    for s_dec, s_raw in zip(a_bf_dec.sums, a_bf_raw.sums):
+        np.testing.assert_array_equal(s_dec, s_raw)
+
+
+def test_wrong_shape_fast_path_report_bounces():
+    """A dense State with mismatched shapes must bounce through the fast
+    ingest exactly like the decode door (same typed error, no state
+    change)."""
+    db = Database(":memory:")
+    ctl = FLController(db)
+    params = _model_params()
+    ctl.create_process(
+        model_blob=serialize_model_params(params),
+        client_plans={"training_plan": _training_plan()},
+        name="mnist-badshape",
+        version="1.0",
+        client_config=dict(CLIENT_CONFIG, name="mnist-badshape"),
+        server_config=dict(SERVER_CONFIG, num_cycles=1),
+    )
+    w = _register_worker(ctl, "bad-shape-w")
+    resp = ctl.assign("mnist-badshape", "1.0", w)
+    bad = [np.zeros((3, 3), np.float32)]
+    with pytest.raises(E.PyGridError, match="shapes"):
+        ctl.submit_diff(
+            "bad-shape-w", resp[CYCLE.KEY], serialize_model_params(bad)
+        )
+    # the assignment is still open and a correct report succeeds
+    good = [np.zeros_like(p) for p in params]
+    ctl.submit_diff("bad-shape-w", resp[CYCLE.KEY], serialize_model_params(good))

@@ -96,3 +96,66 @@ def test_model_params_bf16_wire():
     for a, b in zip(out, params):
         assert a.dtype == np.float32
         np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-4)
+
+
+# ── accumulate kernels (the FL report fold) ──────────────────────────────────
+
+
+def test_accum_f32_matches_numpy_fold():
+    from pygrid_tpu.native import accum_f32
+
+    rng = np.random.default_rng(7)
+    acc = np.zeros((97, 13), np.float64)
+    ref = acc.copy()
+    for w in (1.0, 0.25, 3.5):
+        src = rng.normal(size=(97, 13)).astype(np.float32)
+        accum_f32(acc, src, w)
+        ref += w * src.astype(np.float64)
+    np.testing.assert_array_equal(acc, ref)  # bit-exact: same f64 ops
+
+
+def test_accum_f32_accepts_raw_buffer():
+    from pygrid_tpu.native import accum_f32
+
+    src = np.arange(64, dtype=np.float32)
+    acc = np.zeros(64, np.float64)
+    accum_f32(acc, memoryview(src.tobytes()))
+    np.testing.assert_array_equal(acc, src.astype(np.float64))
+    with pytest.raises(ValueError):
+        accum_f32(np.zeros(3, np.float64), src)
+
+
+def test_accum_bf16_matches_decode_then_fold():
+    from pygrid_tpu.native import accum_bf16, bf16_to_f32, f32_to_bf16
+
+    rng = np.random.default_rng(9)
+    bits = f32_to_bf16(rng.normal(size=801).astype(np.float32))
+    acc = np.full(801, 0.5, np.float64)
+    ref = acc + 2.0 * bf16_to_f32(bits).astype(np.float64)
+    accum_bf16(acc, bits.tobytes(), 2.0)
+    np.testing.assert_array_equal(acc, ref)
+
+
+# ── native base64 ────────────────────────────────────────────────────────────
+
+
+def test_b64_decode_roundtrip_all_pad_lengths():
+    import base64
+
+    from pygrid_tpu.native import b64_decode, b64_decode_view
+
+    rng = np.random.default_rng(3)
+    for n in list(range(0, 12)) + [1000, 4096, 123_457]:
+        payload = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        encoded = base64.b64encode(payload)
+        assert b64_decode(encoded) == payload
+        assert b64_decode(encoded.decode()) == payload
+        assert bytes(b64_decode_view(encoded.decode())) == payload
+
+
+def test_b64_decode_rejects_malformed():
+    from pygrid_tpu.native import b64_decode
+
+    for bad in (b"abc", b"a===", b"ab=c", b"!!!!", b"aGk\n", "péz="):
+        with pytest.raises((ValueError, UnicodeEncodeError)):
+            b64_decode(bad)

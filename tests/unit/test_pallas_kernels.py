@@ -65,3 +65,34 @@ def test_rejects_bad_shapes():
     b = _to_ring(np.zeros((4, 2), dtype=np.uint64))
     with pytest.raises(ValueError):
         pallas_ring_matmul(a, b, interpret=True)
+
+
+@pytest.mark.parametrize("b,m,k,n", [(3, 8, 8, 8), (2, 64, 64, 64), (4, 9, 130, 5)])
+def test_batched_matches_numpy_uint64(b, m, k, n):
+    """ndim-3 door: [B,M,K] @ [B,K,N] vmaps over the same kernel, exact
+    per example (the shape `smpc.kernels.batched_beaver` drives)."""
+    rng = np.random.default_rng(b * 100 + m + k + n)
+    a = rng.integers(0, 2**64, size=(b, m, k), dtype=np.uint64)
+    bb = rng.integers(0, 2**64, size=(b, k, n), dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        truth = np.einsum("bmk,bkn->bmn", a, bb)
+    out = pallas_ring_matmul(_to_ring(a), _to_ring(bb), interpret=True)
+    np.testing.assert_array_equal(_to_np(out), truth)
+
+
+def test_batched_matches_xla_limb_path():
+    rng = np.random.default_rng(77)
+    a = rng.integers(0, 2**64, size=(3, 12, 40), dtype=np.uint64)
+    b = rng.integers(0, 2**64, size=(3, 40, 6), dtype=np.uint64)
+    import jax
+
+    limb = jax.vmap(R._ring_matmul_chunk)(_to_ring(a), _to_ring(b))
+    pallas = pallas_ring_matmul(_to_ring(a), _to_ring(b), interpret=True)
+    np.testing.assert_array_equal(_to_np(pallas), _to_np(limb))
+
+
+def test_batched_rejects_batch_mismatch():
+    a = _to_ring(np.zeros((2, 4, 4), np.uint64))
+    b = _to_ring(np.zeros((3, 4, 4), np.uint64))
+    with pytest.raises(ValueError, match="batch mismatch"):
+        pallas_ring_matmul(a, b, interpret=True)

@@ -85,3 +85,61 @@ def test_unknown_type_raises():
 
     with pytest.raises(TypeError):
         serde.serialize(Foo())
+
+
+def test_state_raw_tensors_zero_copy_fast_path():
+    """The report-ingest cursor returns the same buffers a full decode
+    materializes — without materializing them."""
+    import numpy as np
+
+    from pygrid_tpu.plans.state import serialize_model_params
+    from pygrid_tpu.serde import state_raw_tensors
+
+    rng = np.random.default_rng(0)
+    params = [
+        rng.normal(size=(784, 392)).astype(np.float32),
+        np.zeros(392, np.float32),
+        np.float32(3.25).reshape(()),  # 0-d survives
+    ]
+    for bf16 in (False, True):
+        blob = serialize_model_params(params, bf16=bf16)
+        raws = state_raw_tensors(blob)
+        assert raws is not None and len(raws) == 3
+        for rt, p in zip(raws, params):
+            assert rt.shape == p.shape
+            kind = "bf16" if bf16 else "<f4"
+            assert rt.kind == kind
+            if not bf16:
+                got = np.frombuffer(rt.raw, np.float32).reshape(rt.shape)
+                np.testing.assert_array_equal(got, p)
+        # zero-copy: raw buffers view the original blob (cursor path)
+        assert isinstance(raws[0].raw, memoryview)
+
+
+def test_state_raw_tensors_rejects_non_state():
+    from pygrid_tpu.serde import serialize, state_raw_tensors
+
+    assert state_raw_tensors(serialize({"not": "a state"})) is None
+    assert state_raw_tensors(b"\x00garbage") is None
+    assert state_raw_tensors(b"") is None
+    # sparse envelope (a dict) → None → callers take the full decode door
+    assert state_raw_tensors(serialize({"__pygrid_sparse_diff__": True})) is None
+
+
+def test_state_raw_tensors_consistent_with_decode():
+    """Whatever the cursor accepts must decode to identical tensors via
+    the general door (the two ingest paths may never diverge)."""
+    import numpy as np
+
+    from pygrid_tpu.plans.state import (
+        serialize_model_params,
+        unserialize_model_params,
+    )
+    from pygrid_tpu.serde import state_raw_tensors
+
+    params = [np.arange(24, dtype=np.float32).reshape(4, 6)]
+    blob = serialize_model_params(params)
+    raws = state_raw_tensors(blob)
+    decoded = unserialize_model_params(blob)
+    got = np.frombuffer(raws[0].raw, np.float32).reshape(raws[0].shape)
+    np.testing.assert_array_equal(got, decoded[0])

@@ -145,3 +145,26 @@ def test_schema_evolution_adds_missing_columns(tmp_path):
     row = new.register(name="fresh", extra=7, blob=b"x")
     got = new.first(id=row.id)
     assert got.extra == 7 and got.blob == b"x"
+
+
+def test_column_projection():
+    """query/first/last with columns= materialize only those fields; the
+    rest keep dataclass defaults (the report path must not drag megabyte
+    blob columns through metadata scans)."""
+    import pytest
+
+    from pygrid_tpu.federated import schemas as S
+    from pygrid_tpu.storage.warehouse import Database, Warehouse
+
+    wh = Warehouse(S.WorkerCycle, Database())
+    wh.register(cycle_id=1, worker_id="w1", request_key="k1", diff=b"x" * 100)
+    wh.register(cycle_id=1, worker_id="w2", request_key="k2", diff=b"y" * 100)
+    rows = wh.query(cycle_id=1, columns=("worker_id",))
+    assert sorted(r.worker_id for r in rows) == ["w1", "w2"]
+    assert all(r.diff is None for r in rows)  # default, not loaded
+    row = wh.first(worker_id="w1", columns=("id", "request_key"))
+    assert row.request_key == "k1" and row.diff is None
+    full = wh.last(worker_id="w2")
+    assert full.diff == b"y" * 100
+    with pytest.raises(KeyError):
+        wh.query(columns=("nope",))
