@@ -1,0 +1,169 @@
+"""Accuracy parity on the REFERENCE workload shape: 784-input MNIST-sized
+digits through the reference's exact MLP (784→392→10 —
+``/root/reference/examples/model-centric/01-Create-plan.ipynb`` cell 10)
+on both planes: the fused on-device kernel and the full WS/HTTP cycle
+protocol.
+
+Real MNIST is not fetchable in this environment (zero egress), so the
+data is sklearn's real handwritten digits bilinearly upscaled 8×8 → 28×28
+— real pen strokes at MNIST's input dimensionality, not a Gaussian
+surrogate. The companion module (test_accuracy_parity.py) proves the same
+equivalence on the native 8×8 data; this one closes the input-size gap to
+the reference workload (round-3 verdict item 4)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pygrid_tpu.client import FLClient, ModelCentricFLClient
+from pygrid_tpu.models import mlp
+from pygrid_tpu.parallel import make_scanned_rounds
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.plans.state import serialize_model_params
+
+K = 4                      # workers / client shards
+SIZES = (784, 392, 10)     # the reference MLP, exactly
+ROUNDS = 30
+LR = 0.2
+TARGET_ACC = 0.85
+NAME, VERSION = "mnist-784-parity", "1.0"
+
+
+@pytest.fixture(scope="module")
+def mnist_sized():
+    """Real digits at MNIST dimensionality: sklearn 8×8 images upscaled
+    bilinearly to 28×28 (784 features in [0, 1])."""
+    from scipy.ndimage import zoom
+    from sklearn.datasets import load_digits
+
+    ds = load_digits()
+    imgs = (ds.images / 16.0).astype(np.float32)       # [N, 8, 8]
+    big = zoom(imgs, (1, 3.5, 3.5), order=1)           # [N, 28, 28]
+    X = big.reshape(len(imgs), 784)
+    y = ds.target
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(X))
+    X, y = X[order], y[order]
+    n_train = 1536
+    per = n_train // K
+    return {
+        "train_X": X[:n_train].reshape(K, per, 784),
+        "train_y": np.eye(10, dtype=np.float32)[y[:n_train]].reshape(
+            K, per, 10
+        ),
+        "test_X": X[n_train:],
+        "test_y": y[n_train:],
+    }
+
+
+def _accuracy(params, X, y) -> float:
+    h = np.maximum(X @ np.asarray(params[0]) + np.asarray(params[1]), 0.0)
+    logits = h @ np.asarray(params[2]) + np.asarray(params[3])
+    return float(np.mean(np.argmax(logits, axis=1) == y))
+
+
+def _init_params():
+    return [np.asarray(p) for p in mlp.init(jax.random.PRNGKey(7), SIZES)]
+
+
+@pytest.fixture(scope="module")
+def scanned_result(mnist_sized):
+    params = _init_params()
+    rounds = make_scanned_rounds(mlp.training_step, n_rounds=ROUNDS)
+    final, losses, accs = rounds(
+        params,
+        jnp.asarray(mnist_sized["train_X"]),
+        jnp.asarray(mnist_sized["train_y"]),
+        jnp.float32(LR),
+    )
+    return {
+        "acc": _accuracy(final, mnist_sized["test_X"], mnist_sized["test_y"]),
+        "params": [np.asarray(p) for p in final],
+    }
+
+
+def test_scanned_kernel_reaches_target_accuracy(scanned_result):
+    assert scanned_result["acc"] >= TARGET_ACC, (
+        f"scanned kernel held-out acc {scanned_result['acc']:.3f}"
+    )
+
+
+def test_protocol_reaches_same_accuracy(grid, mnist_sized, scanned_result):
+    """The same 784-d FL run through the real protocol: host on bob, 4
+    binary-wire workers each holding one shard, ROUNDS cycles of FedAvg —
+    both planes must clear the bar AND agree (one local step per cycle
+    makes them the same algorithm)."""
+    params = _init_params()
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    per = mnist_sized["train_X"].shape[1]
+    plan.build(
+        np.zeros((per, 784), np.float32),
+        np.zeros((per, 10), np.float32),
+        np.float32(LR),
+        *params,
+    )
+    mc = ModelCentricFLClient(grid.node_url("bob"))
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": NAME, "version": VERSION,
+            "batch_size": 64, "lr": LR, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": K, "max_workers": K,
+            "min_diffs": K, "max_diffs": K,
+            "num_cycles": ROUNDS,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+        },
+    )
+    assert resp.get("status") == "success", resp
+
+    clients = []
+    for k in range(K):
+        client = FLClient(grid.node_url("bob"), wire="binary")
+        auth = client.authenticate(NAME, VERSION)
+        clients.append((client, auth["worker_id"], k))
+
+    plans = {}
+    for _ in range(ROUNDS):
+        accepted = []
+        for client, wid, k in clients:
+            cyc = client.cycle_request(wid, NAME, VERSION, 1.0, 100.0, 100.0)
+            assert cyc["status"] == "accepted", cyc
+            accepted.append((client, wid, k, cyc))
+        for client, wid, k, cyc in accepted:
+            model_params = client.get_model(
+                wid, cyc["request_key"], cyc["model_id"]
+            )
+            if k not in plans:
+                plans[k] = client.get_plan(
+                    wid, cyc["request_key"], cyc["plans"]["training_plan"]
+                )
+            out = plans[k](
+                mnist_sized["train_X"][k], mnist_sized["train_y"][k],
+                np.float32(LR), *model_params,
+            )
+            new_params = [np.asarray(t) for t in out[2:]]
+            diff = [p - n for p, n in zip(model_params, new_params)]
+            rep = client.report(
+                wid, cyc["request_key"], serialize_model_params(diff)
+            )
+            assert rep.get("status") == "success", rep
+    for client, _, _ in clients:
+        client.close()
+
+    final = mc.retrieve_model(NAME, VERSION)
+    mc.close()
+    acc = _accuracy(final, mnist_sized["test_X"], mnist_sized["test_y"])
+    assert acc >= TARGET_ACC, f"protocol held-out acc {acc:.3f}"
+    assert abs(acc - scanned_result["acc"]) <= 0.02, (
+        f"protocol acc {acc:.3f} vs scanned acc {scanned_result['acc']:.3f}"
+    )
+    for a, b in zip(final, scanned_result["params"]):
+        np.testing.assert_allclose(a, b, atol=5e-3)
