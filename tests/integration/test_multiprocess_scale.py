@@ -1,0 +1,359 @@
+"""Multi-process scaling evidence (round-3 verdict item 5).
+
+Three legs, all with REAL process boundaries:
+
+1. a 4-process ``jax.distributed`` cluster (8 devices: 4 hosts × 2 virtual
+   chips) runs a client-sharded FedAvg round AND a party-sharded SMPC
+   Beaver round whose open collectives cross the process boundary — both
+   checked exactly against single-process ground truth;
+2. a sharded-SMPC scaling table: the same Beaver workload at 1/2/4/8
+   virtual devices, each in its own process, bit-exact at every width
+   (the recorded evidence that the party axis survives re-sharding);
+3. one full Bonawitz SecAgg cycle against a node running as a separate OS
+   process (``python -m pygrid_tpu.node``) — the cycle protocol, WS
+   rounds and checkpoint write all cross the process boundary.
+
+The reference's analog is its multiprocessing grid of socket servers
+(``/root/reference/tests/conftest.py:36-107``); here the in-mesh planes
+ride ``jax.distributed`` + collectives and the protocol plane rides real
+sockets to a real node process.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+
+FOUR_PROC_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+coord, pid = sys.argv[1], int(sys.argv[2])
+jax.distributed.initialize(
+    coordinator_address=coord, num_processes=4, process_id=pid
+)
+assert jax.process_count() == 4, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+import numpy as np
+sys.path.insert(0, {repo!r})
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from pygrid_tpu.models import mlp
+from pygrid_tpu.parallel import make_round, make_sharded_round
+from pygrid_tpu.parallel.distributed import (
+    hybrid_mesh, host_array, local_batch_slice,
+)
+
+# ── leg 1a: FedAvg with the client axis spanning 4 processes ─────────────
+mesh = hybrid_mesh(dcn_axis="clients", ici_axes=("model",), ici_shape=(2,))
+assert mesh.shape == {{"clients": 4, "model": 2}}, dict(mesh.shape)
+
+K, B, D, H, C = 8, 4, 16, 8, 10
+params = [np.asarray(p) for p in mlp.init(jax.random.PRNGKey(0), (D, H, C))]
+rng = np.random.default_rng(0)
+X_global = rng.normal(size=(K, B, D)).astype(np.float32)
+y_global = np.eye(C, dtype=np.float32)[rng.integers(0, C, (K, B))]
+
+rows = local_batch_slice(K, mesh, dcn_axis="clients")
+X = host_array(X_global[rows], mesh, P("clients"))
+y = host_array(y_global[rows], mesh, P("clients"))
+
+round_fn = make_sharded_round(mlp.training_step, mesh, axis="clients")
+new_params, loss, acc = round_fn(params, X, y, jnp.float32(0.1))
+ref_params, ref_loss, _ = make_round(mlp.training_step)(
+    params, X_global, y_global, jnp.float32(0.1)
+)
+np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+for a, b in zip(new_params, ref_params):
+    np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+    )
+print(f"FEDAVG-OK process={{pid}}", flush=True)
+
+# ── leg 1b: Beaver round with the PARTY axis spanning the processes ──────
+from pygrid_tpu.smpc import ring as R
+from pygrid_tpu.smpc.kernels import share_kernel
+from pygrid_tpu.smpc.sharded import make_sharded_beaver
+
+pmesh = hybrid_mesh(dcn_axis="parties", ici_axes=("b",), ici_shape=(2,))
+Pn, Bn, N = 4, 4, 16
+key = jax.random.PRNGKey(1)
+xb = jax.random.bits(key, (Bn, N, N), dtype=jnp.uint32)
+yb = jax.random.bits(jax.random.fold_in(key, 1), (Bn, N, N), dtype=jnp.uint32)
+x_r = R.Ring64(xb, jnp.zeros_like(xb))
+y_r = R.Ring64(yb, jnp.zeros_like(yb))
+
+def stack(v, k):  # [P, B, N, N] party-major stacked shares
+    sh = jax.vmap(lambda t: share_kernel(k, t, Pn))(v)
+    return R.Ring64(jnp.moveaxis(sh.lo, 1, 0), jnp.moveaxis(sh.hi, 1, 0))
+
+x_sh = stack(x_r, jax.random.fold_in(key, 2))
+y_sh = stack(y_r, jax.random.fold_in(key, 3))
+a = R.ring_random(jax.random.fold_in(key, 4), (Bn, N, N))
+b = R.ring_random(jax.random.fold_in(key, 5), (Bn, N, N))
+c = jax.vmap(R.ring_matmul)(a, b)
+a_sh = stack(a, jax.random.fold_in(key, 6))
+b_sh = stack(b, jax.random.fold_in(key, 7))
+c_sh = stack(c, jax.random.fold_in(key, 8))
+
+def localize(s):  # each process feeds only ITS party's shares
+    rows = local_batch_slice(Pn, pmesh, dcn_axis="parties")
+    return R.Ring64(
+        host_array(np.asarray(s.lo)[rows], pmesh, P("parties")),
+        host_array(np.asarray(s.hi)[rows], pmesh, P("parties")),
+    )
+
+combine = make_sharded_beaver(pmesh, op="matmul")
+out_sh = combine(*(localize(s) for s in (x_sh, y_sh, a_sh, b_sh, c_sh)))
+# reconstruct via the sharded open — an exact mod-2^64 collective over
+# the party axis that crosses the process boundary; its output is
+# replicated, so every process can read it
+from pygrid_tpu.smpc.sharded import make_sharded_open
+opened = make_sharded_open(pmesh)(out_sh)
+lo = np.asarray(jax.device_get(opened.lo), np.uint64)
+hi = np.asarray(jax.device_get(opened.hi), np.uint64)
+got = lo | (hi << np.uint64(32))
+xv = np.asarray(xb, np.uint64)
+yv = np.asarray(yb, np.uint64)
+with np.errstate(over="ignore"):
+    want = np.einsum("bmk,bkn->bmn", xv, yv)
+np.testing.assert_array_equal(got, want)
+print(f"SMPC-OK process={{pid}}", flush=True)
+"""
+
+
+SCALE_WORKER = r"""
+import os, sys, time
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=" + sys.argv[1]
+)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, {repo!r})
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from pygrid_tpu.smpc import ring as R
+from pygrid_tpu.smpc.kernels import batched_beaver, share_kernel, reconstruct_kernel
+from pygrid_tpu.smpc.sharded import deal_triples, make_sharded_beaver
+
+n_dev = int(sys.argv[1])
+assert len(jax.devices()) == n_dev
+Pn, B, N = 8, 64, 32
+key = jax.random.PRNGKey(0)
+x = jax.random.bits(key, (B, N, N), dtype=jnp.uint32)
+x_r = R.Ring64(x, jnp.zeros_like(x))
+vm = jax.vmap(lambda v: share_kernel(key, v, Pn))(x_r)   # [B, P, N, N]
+sh = R.Ring64(jnp.moveaxis(vm.lo, 1, 0), jnp.moveaxis(vm.hi, 1, 0))
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(n_dev), ("parties",))
+combine = make_sharded_beaver(mesh, op="matmul")
+a_sh, b_sh, c_sh = deal_triples(
+    jax.random.fold_in(key, 1), (N, N), (N, N), Pn, op="matmul", batch=B
+)
+out = combine(sh, sh, a_sh, b_sh, c_sh)
+
+# exactness across device widths: reconstruct == x@x mod 2^64
+lo = np.asarray(jax.device_get(out.lo), np.uint64)
+hi = np.asarray(jax.device_get(out.hi), np.uint64)
+got = (lo | (hi << np.uint64(32))).sum(axis=0, dtype=np.uint64)
+xv = np.asarray(x, np.uint64)
+with np.errstate(over="ignore"):
+    want = np.einsum("bmk,bkn->bmn", xv, xv)
+np.testing.assert_array_equal(got, want)
+
+t0 = time.perf_counter()
+reps = 5
+for i in range(reps):
+    out = combine(sh, sh, a_sh, b_sh, c_sh)
+jax.block_until_ready(out.lo)
+dt = (time.perf_counter() - t0) / reps
+print(f"SCALE-OK devices={{n_dev}} parties_per_sec={{B * Pn / dt:.0f}}",
+      flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_four_process_dcn_fedavg_and_smpc(tmp_path):
+    script = tmp_path / "four_proc_worker.py"
+    script.write_text(FOUR_PROC_WORKER.format(repo=str(REPO)))
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord, str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=str(REPO),
+        )
+        for pid in range(4)
+    ]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+        assert f"FEDAVG-OK process={pid}" in out
+        assert f"SMPC-OK process={pid}" in out
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+def test_sharded_smpc_exact_at_every_width(tmp_path, n_dev):
+    """The party axis re-shards over 1→8 devices with bit-identical
+    results; each subprocess prints its parties/sec (the scaling table
+    lands in the test log — on virtual CPU devices the numbers measure
+    correct partitioning, not speedup)."""
+    script = tmp_path / f"scale_{n_dev}.py"
+    script.write_text(SCALE_WORKER.format(repo=str(REPO)))
+    proc = subprocess.run(
+        [sys.executable, str(script), str(n_dev)],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert f"SCALE-OK devices={n_dev}" in proc.stdout
+    print(proc.stdout.strip())
+
+
+# ── leg 3: SecAgg across a real process boundary ─────────────────────────
+
+
+def test_secagg_cycle_against_subprocess_node(tmp_path):
+    import jax
+
+    from pygrid_tpu.client import FLClient, ModelCentricFLClient, SecAggSession
+    from pygrid_tpu.federated import secagg
+    from pygrid_tpu.models import mlp
+    from pygrid_tpu.plans.plan import Plan
+
+    D, H, C, B = 20, 8, 4, 4
+    CLIP, N_WORKERS, THRESHOLD = 0.5, 4, 3
+    port = _free_port()
+    node = subprocess.Popen(
+        [sys.executable, "-m", "pygrid_tpu.node", "--id", "mp-secagg",
+         "--port", str(port)],
+        cwd=str(tmp_path),
+        env={**__import__("os").environ,
+             "PYTHONPATH": f"{REPO}:" + __import__("os").environ.get(
+                 "PYTHONPATH", "")},
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    url = f"http://127.0.0.1:{port}"
+    try:
+        import requests
+
+        for _ in range(120):
+            try:
+                if requests.get(url, timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                time.sleep(0.5)
+        else:
+            raise RuntimeError("node subprocess never came up")
+
+        params = [
+            np.asarray(p) for p in mlp.init(jax.random.PRNGKey(3), (D, H, C))
+        ]
+        plan = Plan(name="training_plan", fn=mlp.training_step)
+        plan.build(
+            np.zeros((B, D), np.float32),
+            np.zeros((B, C), np.float32),
+            np.float32(0.1),
+            *params,
+        )
+        mc = ModelCentricFLClient(url)
+        resp = mc.host_federated_training(
+            model=params,
+            client_plans={"training_plan": plan},
+            client_config={
+                "name": "mp-secagg", "version": "1.0",
+                "batch_size": B, "lr": 0.1, "max_updates": 1,
+            },
+            server_config={
+                "min_workers": N_WORKERS, "max_workers": N_WORKERS,
+                "min_diffs": N_WORKERS, "max_diffs": N_WORKERS,
+                "num_cycles": 1,
+                "do_not_reuse_workers_until_cycle": 0,
+                "pool_selection": "random",
+                "secure_aggregation": {
+                    "clip_range": CLIP, "threshold": THRESHOLD,
+                    "phase_timeout": 20.0,
+                },
+            },
+        )
+        assert resp.get("status") == "success", resp
+        mc.close()
+
+        rng = np.random.default_rng(5)
+        diffs = [
+            [rng.normal(0, 0.01, p.shape).astype(np.float32) for p in params]
+            for _ in range(N_WORKERS)
+        ]
+        results: dict[int, str] = {}
+
+        def run_worker(i: int) -> None:
+            try:
+                client = FLClient(url, timeout=60.0)
+                auth = client.authenticate("mp-secagg", "1.0")
+                wid = auth["worker_id"]
+                cyc = client.cycle_request(
+                    wid, "mp-secagg", "1.0",
+                    ping=1.0, download=1000.0, upload=1000.0,
+                )
+                session = SecAggSession(client, wid, cyc["request_key"])
+                session.advertise()
+                session.wait_roster(timeout=30.0)
+                session.upload_shares()
+                session.wait_masking(timeout=30.0)
+                session.report(diffs[i])
+                results[i] = session.finish(timeout=60.0)
+                client.close()
+            except Exception as err:  # noqa: BLE001
+                results[i] = f"error: {err!r}"
+
+        threads = [
+            threading.Thread(target=run_worker, args=(i,), daemon=True)
+            for i in range(N_WORKERS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert all(
+            results.get(i) in ("done", "closed") for i in range(N_WORKERS)
+        ), results
+
+        mc = ModelCentricFLClient(url)
+        latest = mc.retrieve_model("mp-secagg", "1.0")
+        mc.close()
+        expected = [
+            p - np.mean([d[k] for d in diffs], axis=0)
+            for k, p in enumerate(params)
+        ]
+        step = 1.0 / secagg.choose_scale(CLIP, N_WORKERS)
+        for got, want in zip(latest, expected):
+            np.testing.assert_allclose(
+                np.asarray(got), want, atol=N_WORKERS * step + 1e-6
+            )
+    finally:
+        node.kill()
+        node.wait(timeout=10)
