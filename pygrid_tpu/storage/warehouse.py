@@ -214,6 +214,10 @@ class Warehouse:
         self.table = '"' + schema.__name__.lower() + '"'  # quoted: "group"/"user" are reserved words
         self.fields = dataclasses.fields(schema)
         self._field_types = {f.name: f.type for f in self.fields}
+        #: columns ADD'ed by this construction's migration — callers that
+        #: need semantic backfill beyond the column DEFAULT (e.g. marking
+        #: pre-upgrade FedBuff rows as already-flushed) key off this
+        self.migrated_columns: set[str] = set()
         self._create_table()
 
     def _create_table(self) -> None:
@@ -248,6 +252,7 @@ class Warehouse:
         for f in self.fields:
             if f.name in existing:
                 continue
+            self.migrated_columns.add(f.name)
             ddl = (
                 f"ALTER TABLE {self.table} ADD COLUMN "
                 f'"{f.name}" {_column_type(f.type)}'

@@ -167,3 +167,211 @@ def test_straggler_drop_completes_short_cycle():
     finally:
         server.stop()
         tasks.set_sync(prev)
+
+
+def test_fedbuff_restart_keeps_buffered_contributions(tmp_path):
+    """Durable FedBuff: 2 of buffer_size=3 contributions land, the node
+    restarts, the third lands on the fresh instance — the flush includes
+    ALL THREE (the rebuilt buffer recovers diff + staleness base from the
+    worker-cycle rows; round-3 verdict weak-spot 6)."""
+    from pygrid_tpu.node import create_app
+    from pygrid_tpu.plans.state import serialize_model_params
+
+    prev = tasks._sync
+    tasks.set_sync(True)
+    db_url = str(tmp_path / "fedbuff.db")
+    kv_path = str(tmp_path / "fedbuff-kv.db")
+    name = "fedbuff-resume"
+    params = [np.asarray(p) for p in mlp.init(jax.random.PRNGKey(2), (20, 8, 4))]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, 20), np.float32),
+        np.zeros((B, 4), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    rng = np.random.default_rng(9)
+    diffs = [
+        [rng.normal(0, 0.01, p.shape).astype(np.float32) for p in params]
+        for _ in range(3)
+    ]
+
+    def submit(url: str, diff) -> None:
+        client = FLClient(url)
+        wid = client.authenticate(name, VERSION)["worker_id"]
+        cyc = client.cycle_request(
+            wid, name, VERSION, ping=1.0, download=1000.0, upload=1000.0
+        )
+        assert cyc.get("status") == "accepted", cyc
+        out = client.report(
+            wid, cyc["request_key"], serialize_model_params(diff)
+        )
+        assert out.get("status") == "success", out
+        client.close()
+
+    server = ServerThread(
+        create_app("fedbuff-node", database_url=db_url, kv_path=kv_path),
+        _free_port(),
+    ).start()
+    try:
+        mc = ModelCentricFLClient(server.url)
+        resp = mc.host_federated_training(
+            model=params,
+            client_plans={"training_plan": plan},
+            client_config={
+                "name": name, "version": VERSION,
+                "batch_size": B, "lr": 0.1, "max_updates": 1,
+            },
+            server_config={
+                "min_workers": 1, "max_workers": 8,
+                "min_diffs": 1, "max_diffs": 8, "num_cycles": 2,
+                "pool_selection": "random",
+                "do_not_reuse_workers_until_cycle": 0,
+                "async_aggregation": {"buffer_size": 3,
+                                      "staleness_power": 0.5},
+            },
+        )
+        assert resp.get("status") == "success", resp
+        mc.close()
+        submit(server.url, diffs[0])
+        submit(server.url, diffs[1])
+    finally:
+        server.stop()
+
+    server2 = ServerThread(
+        create_app("fedbuff-node", database_url=db_url, kv_path=kv_path),
+        _free_port(),
+    ).start()
+    try:
+        submit(server2.url, diffs[2])  # third contribution → flush fires
+        mc = ModelCentricFLClient(server2.url)
+        latest = mc.retrieve_model(name, VERSION)
+        mc.close()
+        # all three buffered diffs aggregated (equal staleness → plain
+        # mean): params - mean(diffs)
+        expected = [
+            p - np.mean([d[k] for d in diffs], axis=0)
+            for k, p in enumerate(params)
+        ]
+        for got, want in zip(latest, expected):
+            np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+    finally:
+        server2.stop()
+        tasks.set_sync(prev)
+
+
+def test_secagg_restart_aborts_round_and_rekeys(tmp_path):
+    """Mid-SecAgg restart: the round's key state is gone, so the restarted
+    node CLOSES the marked cycle (recover_secagg) — a client polling the
+    dead round gets a typed error promptly, and a fresh session completes
+    the key rounds on the next cycle."""
+    from pygrid_tpu.client import SecAggSession
+    from pygrid_tpu.node import create_app
+    from pygrid_tpu.utils.exceptions import PyGridError
+
+    prev = tasks._sync
+    tasks.set_sync(True)
+    db_url = str(tmp_path / "secagg.db")
+    kv_path = str(tmp_path / "secagg-kv.db")
+    name = "secagg-resume"
+    params = [np.asarray(p) for p in mlp.init(jax.random.PRNGKey(3), (20, 8, 4))]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((4, 20), np.float32),
+        np.zeros((4, 4), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+
+    server = ServerThread(
+        create_app("secagg-node", database_url=db_url, kv_path=kv_path),
+        _free_port(),
+    ).start()
+    try:
+        mc = ModelCentricFLClient(server.url)
+        resp = mc.host_federated_training(
+            model=params,
+            client_plans={"training_plan": plan},
+            client_config={
+                "name": name, "version": VERSION,
+                "batch_size": 4, "lr": 0.1, "max_updates": 1,
+            },
+            server_config={
+                "min_workers": 2, "max_workers": 2,
+                "min_diffs": 2, "max_diffs": 2, "num_cycles": 3,
+                "pool_selection": "random",
+                "do_not_reuse_workers_until_cycle": 0,
+                "secure_aggregation": {"clip_range": 0.5, "threshold": 2,
+                                       "phase_timeout": 20.0},
+            },
+        )
+        assert resp.get("status") == "success", resp
+        mc.close()
+        # a round starts: one worker advertises, then the node dies
+        client = FLClient(server.url, timeout=30.0)
+        wid = client.authenticate(name, VERSION)["worker_id"]
+        cyc = client.cycle_request(
+            wid, name, VERSION, ping=1.0, download=1000.0, upload=1000.0
+        )
+        assert cyc.get("status") == "accepted", cyc
+        session = SecAggSession(client, wid, cyc["request_key"])
+        session.advertise()
+        client.close()
+    finally:
+        server.stop()
+
+    server2 = ServerThread(
+        create_app("secagg-node", database_url=db_url, kv_path=kv_path),
+        _free_port(),
+    ).start()
+    try:
+        # the dead round's key is now invalid — a poll errors out in one
+        # round trip instead of hanging until the client's own timeout
+        client = FLClient(server2.url, timeout=30.0)
+        stale = SecAggSession(client, wid, cyc["request_key"])
+        with pytest.raises(PyGridError):
+            stale._send("model-centric/secagg-status")
+        client.close()
+
+        # fresh sessions complete a full round on the freshly-spawned cycle
+        import threading
+
+        results: dict[int, str] = {}
+        rng = np.random.default_rng(4)
+        diffs = [
+            [rng.normal(0, 0.01, p.shape).astype(np.float32) for p in params]
+            for _ in range(2)
+        ]
+
+        def worker(i: int) -> None:
+            try:
+                c = FLClient(server2.url, timeout=30.0)
+                w = c.authenticate(name, VERSION)["worker_id"]
+                cy = c.cycle_request(
+                    w, name, VERSION, ping=1.0, download=1000.0, upload=1000.0
+                )
+                s = SecAggSession(c, w, cy["request_key"])
+                s.advertise()
+                s.wait_roster(timeout=20.0)
+                s.upload_shares()
+                s.wait_masking(timeout=20.0)
+                s.report(diffs[i])
+                results[i] = s.finish(timeout=40.0)
+                c.close()
+            except Exception as err:  # noqa: BLE001
+                results[i] = f"error: {err!r}"
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90.0)
+        assert all(
+            results.get(i) in ("done", "closed") for i in range(2)
+        ), results
+    finally:
+        server2.stop()
+        tasks.set_sync(prev)

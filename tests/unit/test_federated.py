@@ -605,3 +605,32 @@ def test_wrong_shape_fast_path_report_bounces():
     # the assignment is still open and a correct report succeeds
     good = [np.zeros_like(p) for p in params]
     ctl.submit_diff("bad-shape-w", resp[CYCLE.KEY], serialize_model_params(good))
+
+
+def test_fedbuff_migration_marks_preexisting_rows_flushed():
+    """A pre-durability DB (no `flushed` column) migrates with every
+    completed row marked flushed — whatever those rows contributed was
+    handled by the old in-memory flush, and they must never re-enter a
+    buffer and double-apply onto the current checkpoint."""
+    db = Database(":memory:")
+    db.execute(
+        'CREATE TABLE "workercycle" ('
+        "id INTEGER PRIMARY KEY AUTOINCREMENT, cycle_id INTEGER, "
+        "worker_id TEXT, request_key TEXT, started_at TEXT, "
+        "is_completed INTEGER, completed_at TEXT, diff BLOB, "
+        "assigned_checkpoint INTEGER, metrics BLOB)"
+    )
+    db.execute(
+        'INSERT INTO "workercycle" (cycle_id, worker_id, request_key, '
+        "is_completed, diff) VALUES (1, 'old-w', 'old-k', 1, x'00')"
+    )
+    db.execute(
+        'INSERT INTO "workercycle" (cycle_id, worker_id, request_key, '
+        "is_completed) VALUES (1, 'open-w', 'open-k', 0)"
+    )
+    ctl = FLController(db)
+    done = ctl.cycle_manager._worker_cycles.first(worker_id="old-w")
+    assert done.flushed is True
+    still_open = ctl.cycle_manager._worker_cycles.first(worker_id="open-w")
+    assert not still_open.flushed
+    assert ctl.cycle_manager._async_buffered_count(0) == 0
