@@ -438,14 +438,80 @@ _CALL_OPS = (
 )
 
 
-def run_oplist(oplist: dict, *args: Any, backend: str = "jax") -> Any:
+#: ceiling on any single array an op-list may allocate (~1 GB f32): the
+#: dialect executes REMOTE-SUPPLIED programs, and a few hundred bytes of
+#: envelope must not be able to demand a multi-TB iota/broadcast (same
+#: posture as compression.MAX_DENSE_ELEMENTS)
+MAX_OPLIST_ELEMENTS = 1 << 28
+#: nested call-op depth bound — a hostile envelope of self-nesting jaxprs
+#: must fail typed, not exhaust the interpreter stack
+MAX_OPLIST_DEPTH = 64
+
+#: ops whose params directly size an output allocation
+_ALLOC_SHAPE_PARAMS = {
+    "iota": "shape",
+    "broadcast_in_dim": "shape",
+    "reshape": "new_sizes",
+}
+
+#: ops whose OUTPUT can dwarf their inputs even when every operand is
+#: within bounds (outer-product dot_general, dilated conv) — their output
+#: shape is derived abstractly (eval_shape allocates nothing) and bounded
+_EXPANSION_OPS = ("dot_general", "conv_general_dilated")
+
+
+def _check_alloc(op: str, params: dict, invals: tuple = ()) -> None:
+    key = _ALLOC_SHAPE_PARAMS.get(op)
+    if key is not None and key in params:
+        dims = _dims(params[key])
+        n = 1
+        for d in dims:
+            if d < 0:
+                raise PlanTranslationError(f"{op}: negative dim in {dims}")
+            n *= d
+        if n > MAX_OPLIST_ELEMENTS:
+            raise PlanTranslationError(
+                f"{op}: output of {n} elements exceeds the "
+                f"{MAX_OPLIST_ELEMENTS}-element allocation bound"
+            )
+        return
+    if op in _EXPANSION_OPS:
+        jfn = _INTERP_TABLE.get(op)
+        if jfn is None:
+            return
+        try:
+            out = jax.eval_shape(lambda *xs: jfn(*xs, params), *invals)
+        except PlanTranslationError:
+            raise
+        except Exception as err:  # noqa: BLE001 — hostile params
+            raise PlanTranslationError(f"{op}: invalid params: {err}") from err
+        for leaf in jax.tree_util.tree_leaves(out):
+            if leaf.size > MAX_OPLIST_ELEMENTS:
+                raise PlanTranslationError(
+                    f"{op}: output of {leaf.size} elements exceeds the "
+                    f"{MAX_OPLIST_ELEMENTS}-element allocation bound"
+                )
+
+
+def run_oplist(
+    oplist: dict, *args: Any, backend: str = "jax", _depth: int = 0
+) -> Any:
     """Interpret the portable op-list dialect. Returns the plan outputs.
 
     ``backend="jax"`` executes on the accelerator via jnp/lax (the
     reference interpreter); ``backend="numpy"`` executes with numpy only —
     the path proving a non-XLA client (the tfjs-analog consumer,
     reference plan_manager.py:119-149) can run a hosted training plan.
+
+    Op-lists are remote-supplied: allocation sizes and call-nesting depth
+    are bounded, and any malformed structure fails with a typed
+    :class:`PlanTranslationError` (the transport frames it back to the
+    sender — runtime/worker.py error contract).
     """
+    if _depth > MAX_OPLIST_DEPTH:
+        raise PlanTranslationError(
+            f"oplist call nesting exceeds {MAX_OPLIST_DEPTH}"
+        )
     if backend == "numpy":
         table, lift = _NUMPY_TABLE, np.asarray
     else:
@@ -479,7 +545,9 @@ def run_oplist(oplist: dict, *args: Any, backend: str = "jax") -> Any:
                     break
             if inner is None:
                 raise PlanTranslationError(f"no inner jaxpr for {op}")
-            outs = run_oplist(inner, *invals, backend=backend)
+            outs = run_oplist(
+                inner, *invals, backend=backend, _depth=_depth + 1
+            )
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
         else:
             fn = table.get(op)
@@ -487,6 +555,7 @@ def run_oplist(oplist: dict, *args: Any, backend: str = "jax") -> Any:
                 raise PlanTranslationError(
                     f"op {op!r} not in portable dialect ({backend} backend)"
                 )
+            _check_alloc(op, params, tuple(invals))
             outs = [fn(params)] if op == "iota" else [fn(*invals, params)]
         for oid, oval in zip(eqn["out"], outs):
             env[oid] = oval
