@@ -47,6 +47,19 @@ STACKS: dict[str, dict] = {
         "deployment_type": "serverless",
         "app": {"name": "network", "port": 7000},
     },
+    # the reference's own concrete cloud target (its deploy/serverless-node
+    # stack) — coordination plane on AWS; TPU compute stays on GCP
+    "aws-serverless-node": {
+        "provider": "aws",
+        "deployment_type": "serverless",
+        "app": {"name": "node", "id": "alice", "port": 5000},
+    },
+    "aws-serverfull-node": {
+        "provider": "aws",
+        "deployment_type": "serverfull",
+        "app": {"name": "node", "id": "alice", "port": 5000,
+                "network": "http://network.example.com:7000"},
+    },
 }
 
 

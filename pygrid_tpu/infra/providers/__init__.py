@@ -2,18 +2,22 @@
 
 Parity: the dispatch table in reference ``api/__main__.py:22-35``
 (provider × deployment_type → builder class; azure/gcp were empty stubs
-there — here GCP is the first-class target and AWS/Azure raise clearly)."""
+there — here GCP is the first-class TPU target, AWS renders runnable
+stacks for the coordination plane, and azure raises clearly)."""
 
 from __future__ import annotations
 
 from pygrid_tpu.infra.config import DeployConfig
 from pygrid_tpu.infra.providers.base import Provider, server_command
+from pygrid_tpu.infra.providers.aws import AWSServerfull, AWSServerless
 from pygrid_tpu.infra.providers.gcp import GCPServerfull, GCPServerless
 from pygrid_tpu.infra.providers.local import LocalProvider
 
 __all__ = ["build_provider", "Provider", "server_command"]
 
 _REGISTRY = {
+    ("aws", "serverfull"): AWSServerfull,
+    ("aws", "serverless"): AWSServerless,
     ("gcp", "serverfull"): GCPServerfull,
     ("gcp", "serverless"): GCPServerless,
     ("local", "serverfull"): LocalProvider,

@@ -1,0 +1,330 @@
+"""AWS providers — the reference's concrete cloud target, translated.
+
+Parity: reference ``api/providers/aws/serverless.py:26-351`` builds
+S3 + Lambda layer + API Gateway over EFS-mounted deps with terrascript,
+and ``deploy/serverless-node/*.tf`` is its hand-written twin;
+``serverfull.py:22-23`` is an empty ``deploy(): pass`` stub. Here both
+modes render runnable terraform JSON (same ``Provider.deploy`` flow as
+GCP — write configs, ``terraform init/apply``):
+
+- **serverless** → a container-image Lambda (VPC-attached, image from
+  ECR via ``-var image_uri=...``) fronted by a Lambda Function URL
+  (the modern replacement for the reference's API Gateway +
+  layer-on-EFS packaging; the coordination plane is pure asyncio/SQL
+  and fits Lambda exactly like the reference's Flask app did), with
+  the grid DB on an EFS access point + mount target — the same
+  durability role EFS plays in the reference stack.
+- **serverfull** → an EC2 instance running the node/network server via
+  user-data (the reference never implemented this mode at all). AWS
+  has no TPUs, so this mode serves the COORDINATION plane; TPU compute
+  stays on the GCP providers — a cross-cloud grid registers AWS-hosted
+  nodes with the network like any other address.
+"""
+
+from __future__ import annotations
+
+import shlex
+
+from pygrid_tpu.infra.config import DeployConfig
+from pygrid_tpu.infra.providers.base import Provider, server_command, shell_line
+
+
+def _user_data(config: DeployConfig) -> str:
+    cmd = shell_line(server_command(config))
+    return "\n".join(
+        [
+            "#!/bin/bash",
+            "set -e",
+            "pip install pygrid-tpu",
+            f"export DATABASE_URL={shlex.quote(config.db.url)}",
+            f"exec {cmd}",
+        ]
+    ) + "\n"
+
+
+def _region(config: DeployConfig) -> str:
+    """The shared config carries a GCP-style zone by default; accept an
+    AWS region (``us-east-1``) or availability zone (``eu-west-2a`` →
+    region ``eu-west-2``); anything GCP-shaped (``us-central1-a``) falls
+    back to us-east-1."""
+    import re
+
+    m = re.fullmatch(r"([a-z]{2}(?:-[a-z]+)+-\d+)([a-z])?", config.tpu.zone)
+    return m.group(1) if m else "us-east-1"
+
+
+class AWSServerfull(Provider):
+    """EC2-hosted node/network server (the mode the reference stubbed)."""
+
+    name = "aws-serverfull"
+
+    def render(self) -> dict[str, str]:
+        cfg, app = self.config, self.config.app
+        name = f"pygrid-{app.name}-{app.id or app.name}"
+        doc = {
+            "terraform": {
+                "required_providers": {
+                    "aws": {"source": "hashicorp/aws"}
+                }
+            },
+            "provider": {"aws": {"region": _region(cfg)}},
+            "resource": {
+                "aws_security_group": {
+                    "grid_ingress": {
+                        "name": f"{name}-ingress",
+                        "ingress": [
+                            {
+                                "from_port": app.port,
+                                "to_port": app.port,
+                                "protocol": "tcp",
+                                "cidr_blocks": ["0.0.0.0/0"],
+                                "description": "grid WS/HTTP",
+                                "ipv6_cidr_blocks": [],
+                                "prefix_list_ids": [],
+                                "security_groups": [],
+                                "self": False,
+                            }
+                        ],
+                        "egress": [
+                            {
+                                "from_port": 0,
+                                "to_port": 0,
+                                "protocol": "-1",
+                                "cidr_blocks": ["0.0.0.0/0"],
+                                "description": "all egress",
+                                "ipv6_cidr_blocks": [],
+                                "prefix_list_ids": [],
+                                "security_groups": [],
+                                "self": False,
+                            }
+                        ],
+                    }
+                },
+                "aws_instance": {
+                    "grid_app": {
+                        "ami": "${data.aws_ami.al2023.id}",
+                        "instance_type": "t3.medium",
+                        "vpc_security_group_ids": [
+                            "${aws_security_group.grid_ingress.id}"
+                        ],
+                        "user_data": _user_data(cfg),
+                        "tags": {"Name": name},
+                    }
+                },
+            },
+            "data": {
+                "aws_ami": {
+                    "al2023": {
+                        "most_recent": True,
+                        "owners": ["amazon"],
+                        "filter": [
+                            {
+                                "name": "name",
+                                "values": ["al2023-ami-*-x86_64"],
+                            }
+                        ],
+                    }
+                }
+            },
+            "output": {
+                "endpoint": {
+                    "value": "${aws_instance.grid_app.public_dns}"
+                }
+            },
+        }
+        return {
+            "main.tf.json": self._json(doc),
+            "user_data.sh": _user_data(cfg),
+        }
+
+
+class AWSServerless(Provider):
+    """Container Lambda + Function URL + EFS-backed grid database.
+
+    Lambda with an EFS mount MUST be VPC-attached with a mount target
+    reachable from its subnets — the stack wires the account's default
+    VPC (data sources) rather than minting one, mirroring the
+    reference's reuse of an existing VPC in its hand-written HCL. The
+    container image is a terraform variable (``-var image_uri=...``):
+    it must live in ECR, which this stack cannot conjure."""
+
+    name = "aws-serverless"
+
+    def render(self) -> dict[str, str]:
+        cfg, app = self.config, self.config.app
+        name = f"pygrid-{app.name}"
+        doc = {
+            "terraform": {
+                "required_providers": {
+                    "aws": {"source": "hashicorp/aws"}
+                }
+            },
+            "provider": {"aws": {"region": _region(cfg)}},
+            "variable": {
+                "image_uri": {
+                    "type": "string",
+                    "description": (
+                        "ECR URI of the grid container image "
+                        "(e.g. <acct>.dkr.ecr.<region>.amazonaws.com/"
+                        "pygrid-tpu:latest)"
+                    ),
+                }
+            },
+            "data": {
+                "aws_vpc": {"default": {"default": True}},
+                "aws_subnets": {
+                    "default": {
+                        "filter": [
+                            {
+                                "name": "vpc-id",
+                                "values": ["${data.aws_vpc.default.id}"],
+                            }
+                        ]
+                    }
+                },
+            },
+            "resource": {
+                "aws_security_group": {
+                    "grid_efs": {
+                        "name": f"{name}-efs",
+                        "vpc_id": "${data.aws_vpc.default.id}",
+                        "ingress": [
+                            {
+                                "from_port": 2049,
+                                "to_port": 2049,
+                                "protocol": "tcp",
+                                "cidr_blocks": [
+                                    "${data.aws_vpc.default.cidr_block}"
+                                ],
+                                "description": "NFS from the VPC",
+                                "ipv6_cidr_blocks": [],
+                                "prefix_list_ids": [],
+                                "security_groups": [],
+                                "self": True,
+                            }
+                        ],
+                        "egress": [
+                            {
+                                "from_port": 0,
+                                "to_port": 0,
+                                "protocol": "-1",
+                                "cidr_blocks": ["0.0.0.0/0"],
+                                "description": "all egress",
+                                "ipv6_cidr_blocks": [],
+                                "prefix_list_ids": [],
+                                "security_groups": [],
+                                "self": False,
+                            }
+                        ],
+                    }
+                },
+                "aws_efs_file_system": {
+                    "grid_db": {"tags": {"Name": f"{name}-db"}}
+                },
+                "aws_efs_mount_target": {
+                    "grid_db": {
+                        "file_system_id": (
+                            "${aws_efs_file_system.grid_db.id}"
+                        ),
+                        "subnet_id": (
+                            "${data.aws_subnets.default.ids[0]}"
+                        ),
+                        "security_groups": [
+                            "${aws_security_group.grid_efs.id}"
+                        ],
+                    }
+                },
+                "aws_efs_access_point": {
+                    "grid_db": {
+                        "file_system_id": (
+                            "${aws_efs_file_system.grid_db.id}"
+                        ),
+                        "root_directory": {
+                            "path": "/pygrid",
+                            "creation_info": {
+                                "owner_uid": 1000,
+                                "owner_gid": 1000,
+                                "permissions": "0755",
+                            },
+                        },
+                        "posix_user": {"uid": 1000, "gid": 1000},
+                    }
+                },
+                "aws_iam_role": {
+                    "grid_lambda": {
+                        "name": f"{name}-lambda-role",
+                        "assume_role_policy": (
+                            '{"Version": "2012-10-17", "Statement": '
+                            '[{"Action": "sts:AssumeRole", "Effect": '
+                            '"Allow", "Principal": {"Service": '
+                            '"lambda.amazonaws.com"}}]}'
+                        ),
+                    }
+                },
+                "aws_iam_role_policy_attachment": {
+                    "grid_lambda_vpc": {
+                        "role": "${aws_iam_role.grid_lambda.name}",
+                        "policy_arn": (
+                            "arn:aws:iam::aws:policy/service-role/"
+                            "AWSLambdaVPCAccessExecutionRole"
+                        ),
+                    },
+                    "grid_lambda_efs": {
+                        "role": "${aws_iam_role.grid_lambda.name}",
+                        "policy_arn": (
+                            "arn:aws:iam::aws:policy/"
+                            "AmazonElasticFileSystemClientReadWriteAccess"
+                        ),
+                    },
+                },
+                "aws_lambda_function": {
+                    "grid_app": {
+                        "function_name": name,
+                        "package_type": "Image",
+                        "image_uri": "${var.image_uri}",
+                        "role": "${aws_iam_role.grid_lambda.arn}",
+                        "timeout": 900,
+                        "memory_size": 1024,
+                        "environment": {
+                            "variables": {
+                                "DATABASE_URL": "sqlite:////mnt/pygrid/grid.db",
+                                "PYGRID_APP_ARGS": shell_line(
+                                    server_command(cfg)[1:]
+                                ),
+                            }
+                        },
+                        "vpc_config": {
+                            "subnet_ids": (
+                                "${data.aws_subnets.default.ids}"
+                            ),
+                            "security_group_ids": [
+                                "${aws_security_group.grid_efs.id}"
+                            ],
+                        },
+                        "file_system_config": {
+                            "arn": (
+                                "${aws_efs_access_point.grid_db.arn}"
+                            ),
+                            "local_mount_path": "/mnt/pygrid",
+                        },
+                        "depends_on": ["aws_efs_mount_target.grid_db"],
+                    }
+                },
+                "aws_lambda_function_url": {
+                    "grid_url": {
+                        "function_name": (
+                            "${aws_lambda_function.grid_app.function_name}"
+                        ),
+                        "authorization_type": "NONE",
+                    }
+                },
+            },
+            "output": {
+                "endpoint": {
+                    "value": (
+                        "${aws_lambda_function_url.grid_url.function_url}"
+                    )
+                }
+            },
+        }
+        return {"main.tf.json": self._json(doc)}

@@ -455,9 +455,10 @@ _ALLOC_SHAPE_PARAMS = {
 }
 
 #: ops whose OUTPUT can dwarf their inputs even when every operand is
-#: within bounds (outer-product dot_general, dilated conv) — their output
-#: shape is derived abstractly (eval_shape allocates nothing) and bounded
-_EXPANSION_OPS = ("dot_general", "conv_general_dilated")
+#: within bounds (outer-product dot_general, dilated conv, a concatenate
+#: repeating one bound-passing operand many times) — their output shape
+#: is derived abstractly (eval_shape allocates nothing) and bounded
+_EXPANSION_OPS = ("dot_general", "conv_general_dilated", "concatenate")
 
 
 def _check_alloc(op: str, params: dict, invals: tuple = ()) -> None:

@@ -282,9 +282,13 @@ def test_serde_deserialize_never_hangs_or_crashes_harness(blob):
     way the transport layer's typed-error contract can frame it."""
     try:
         deserialize(blob)
-    except Exception as err:  # noqa: BLE001 — the assertion IS the type
-        assert not isinstance(err, (SystemExit, KeyboardInterrupt, MemoryError))
-    assert state_raw_tensors(blob) is None or True
+    except MemoryError:  # noqa: PERF203 — the assertion IS the type
+        pytest.fail("deserialize allocated unboundedly on garbage input")
+    except Exception:  # noqa: BLE001 — any typed error is acceptable
+        pass
+    # the fast-path scanner must never raise at all on garbage
+    out = state_raw_tensors(blob)
+    assert out is None or isinstance(out, list)
 
 
 def test_oplist_outer_product_dot_bounded():
@@ -325,4 +329,21 @@ def test_oplist_hostile_dot_params_typed():
         outvars=[{"var": 2}],
     )
     with pytest.raises(PlanTranslationError, match="invalid params"):
+        run_oplist(evil, backend="numpy")
+
+
+def test_oplist_concatenate_fanout_bounded():
+    """One bound-passing operand repeated many times into concatenate —
+    the multi-input escape from the per-op allocation bound."""
+    evil = _empty_oplist(
+        eqns=[
+            {"op": "iota", "params": {
+                "dtype": "float32", "shape": [1 << 24], "dimension": 0,
+            }, "in": [], "out": [1]},
+            {"op": "concatenate", "params": {"dimension": 0},
+             "in": [{"var": 1}] * 64, "out": [2]},
+        ],
+        outvars=[{"var": 2}],
+    )
+    with pytest.raises(PlanTranslationError, match="allocation bound"):
         run_oplist(evil, backend="numpy")

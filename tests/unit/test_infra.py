@@ -72,7 +72,7 @@ def test_unknown_provider_rejected(tmp_path):
     with pytest.raises(ValueError):
         DeployConfig(provider="ibm")
     with pytest.raises(NotImplementedError):
-        build_provider(_node_config(tmp_path, provider="aws"))
+        build_provider(_node_config(tmp_path, provider="azure"))
 
 
 def test_handle_deploy_roundtrip(tmp_path):
@@ -132,3 +132,29 @@ def test_cli_dry_run_flag(tmp_path, capsys):
     assert tf.exists()
     doc = json.load(open(tf))
     assert "google_tpu_v2_vm" in doc["resource"]
+
+
+def test_aws_serverfull_renders_ec2(tmp_path):
+    import json as _json
+
+    cfg = _node_config(tmp_path, provider="aws")
+    files = build_provider(cfg).render()
+    doc = _json.loads(files["main.tf.json"])
+    inst = doc["resource"]["aws_instance"]["grid_app"]
+    assert "pip install pygrid-tpu" in inst["user_data"]
+    sg = doc["resource"]["aws_security_group"]["grid_ingress"]
+    assert sg["ingress"][0]["from_port"] == cfg.app.port
+    assert doc["provider"]["aws"]["region"]  # zone mapped or default
+
+
+def test_aws_serverless_renders_lambda_with_efs(tmp_path):
+    import json as _json
+
+    cfg = _node_config(tmp_path, provider="aws", deployment_type="serverless")
+    files = build_provider(cfg).render()
+    doc = _json.loads(files["main.tf.json"])
+    fn = doc["resource"]["aws_lambda_function"]["grid_app"]
+    assert fn["package_type"] == "Image"
+    assert fn["file_system_config"]["local_mount_path"] == "/mnt/pygrid"
+    assert "aws_lambda_function_url" in doc["resource"]
+    assert "aws_efs_file_system" in doc["resource"]
