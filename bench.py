@@ -596,7 +596,9 @@ def bench_fed_transformer() -> dict:
         + 12.0 * cfg.n_layers * L * cfg.d_model * tokens_per_round
     )
 
-    step = transformer.make_training_step(cfg, attn_fn=flash_attention)
+    step = transformer.make_training_step(
+        cfg, attn_fn=flash_attention, compute_dtype="bfloat16"
+    )
     params = transformer.init(jax.random.PRNGKey(0), cfg)
     X = jax.random.randint(jax.random.PRNGKey(1), (Kc, Bc, L), 0, cfg.vocab)
     y = jnp.roll(X, -1, axis=-1)
@@ -634,6 +636,9 @@ def bench_fed_transformer() -> dict:
         "fed_transformer_tokens_per_sec": round(tok_s, 0),
         "fed_transformer_mfu_pct": round(mfu * 100, 1),
         "fed_transformer_ms_per_round": round(per * 1e3, 2),
+        # recorded so cross-round comparisons never mistake a dtype
+        # change for an optimization
+        "fed_transformer_compute_dtype": "bfloat16",
     }
 
 

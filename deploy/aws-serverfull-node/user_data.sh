@@ -1,5 +1,6 @@
 #!/bin/bash
 set -e
-pip install pygrid-tpu
+dnf install -y python3-pip
+python3 -m pip install pygrid-tpu
 export DATABASE_URL=grid.db
-exec python -m pygrid_tpu.node --id alice --host 0.0.0.0 --port 5000 --network http://network.example.com:7000
+exec python3 -m pygrid_tpu.node --id alice --host 0.0.0.0 --port 5000 --network http://network.example.com:7000

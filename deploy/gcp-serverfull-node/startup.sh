@@ -1,5 +1,5 @@
 #!/bin/bash
 set -e
-pip install pygrid-tpu
+python -m pip install pygrid-tpu
 export DATABASE_URL=grid.db
 exec python -m pygrid_tpu.node --id alice --host 0.0.0.0 --port 5000 --network http://network.example.com:7000

@@ -23,23 +23,23 @@ GCP — write configs, ``terraform init/apply``):
 
 from __future__ import annotations
 
-import shlex
-
 from pygrid_tpu.infra.config import DeployConfig
-from pygrid_tpu.infra.providers.base import Provider, server_command, shell_line
+from pygrid_tpu.infra.providers.base import (
+    Provider,
+    bootstrap_script,
+    server_command,
+)
 
 
 def _user_data(config: DeployConfig) -> str:
-    cmd = shell_line(server_command(config))
-    return "\n".join(
-        [
-            "#!/bin/bash",
-            "set -e",
-            "pip install pygrid-tpu",
-            f"export DATABASE_URL={shlex.quote(config.db.url)}",
-            f"exec {cmd}",
-        ]
-    ) + "\n"
+    # AL2023 ships python3 with no pip (and no `python` alias at all) —
+    # the preinstall step and interpreter name differ from GCP's TPU-VM
+    # image; the boot sequence itself is the shared bootstrap
+    return bootstrap_script(
+        config,
+        python="python3",
+        preinstall=("dnf install -y python3-pip",),
+    )
 
 
 def _region(config: DeployConfig) -> str:
@@ -146,7 +146,17 @@ class AWSServerless(Provider):
     VPC (data sources) rather than minting one, mirroring the
     reference's reuse of an existing VPC in its hand-written HCL. The
     container image is a terraform variable (``-var image_uri=...``):
-    it must live in ECR, which this stack cannot conjure."""
+    it must live in ECR and bundle the AWS Lambda Web Adapter (the
+    request/response bridge container Lambdas need to front an HTTP
+    server; ``AWS_LWA_PORT`` is wired for it).
+
+    Scope honesty: a Function URL speaks request/response HTTP only —
+    NO WebSockets. The node's full model-centric flow has HTTP mirrors
+    (authenticate / cycle-request / report POSTs + GET downloads,
+    node/routes.py), so HTTP-wire FL clients work against this stack;
+    WS clients and the data-centric binary plane need the serverfull
+    (EC2/TPU-VM) deployment — the same coordination-plane-only posture
+    the reference's Lambda mode had in practice."""
 
     name = "aws-serverless"
 
@@ -285,12 +295,24 @@ class AWSServerless(Provider):
                         "role": "${aws_iam_role.grid_lambda.arn}",
                         "timeout": 900,
                         "memory_size": 1024,
+                        # one execution environment: the grid DB is
+                        # sqlite on EFS, and SQLite's POSIX locks are
+                        # not reliable over NFS across concurrent
+                        # writers — serialize at the Lambda layer
+                        "reserved_concurrent_executions": 1,
+                        # the stack's app/id/port configuration drives
+                        # the container via the image command override;
+                        # AWS_LWA_PORT points the web adapter (which the
+                        # image must bundle — see the class docstring)
+                        # at the server
+                        "image_config": {
+                            "command": server_command(cfg)
+                        },
                         "environment": {
                             "variables": {
                                 "DATABASE_URL": "sqlite:////mnt/pygrid/grid.db",
-                                "PYGRID_APP_ARGS": shell_line(
-                                    server_command(cfg)[1:]
-                                ),
+                                "AWS_LWA_PORT": str(app.port),
+                                "PORT": str(app.port),
                             }
                         },
                         "vpc_config": {

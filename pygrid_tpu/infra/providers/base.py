@@ -73,6 +73,30 @@ def shell_line(argv: list[str]) -> str:
     return " ".join(shlex.quote(a) for a in argv)
 
 
+def bootstrap_script(
+    config: "DeployConfig",
+    python: str = "python",
+    preinstall: tuple[str, ...] = (),
+    extra_env: dict[str, str] | None = None,
+) -> str:
+    """The shared VM boot script (cloud-init user-data / startup-script):
+    install the package, export config env, exec the grid server. Each
+    provider parameterizes the interpreter name and distro preinstall
+    steps instead of copying the sequence (AL2023 ships python3 and no
+    pip; GCP TPU-VM images ship both)."""
+    import shlex
+
+    cmd = server_command(config)
+    cmd[0] = python
+    lines = ["#!/bin/bash", "set -e", *preinstall,
+             f"{python} -m pip install pygrid-tpu",
+             f"export DATABASE_URL={shlex.quote(config.db.url)}"]
+    for key, value in (extra_env or {}).items():
+        lines.append(f"export {key}={shlex.quote(value)}")
+    lines.append(f"exec {shell_line(cmd)}")
+    return "\n".join(lines) + "\n"
+
+
 def server_command(config: DeployConfig) -> list[str]:
     """The grid server argv for this app — shared by every provider's
     startup script (the analog of reference ``apps/node/entrypoint.sh``)."""

@@ -17,26 +17,20 @@ hand-written equivalent. The TPU-native translation:
 
 from __future__ import annotations
 
-import shlex
-
 from pygrid_tpu.infra.config import DeployConfig
-from pygrid_tpu.infra.providers.base import Provider, server_command, shell_line
+from pygrid_tpu.infra.providers.base import (
+    Provider,
+    bootstrap_script,
+    server_command,
+)
 
 
 def _startup_script(config: DeployConfig) -> str:
-    cmd = shell_line(server_command(config))
-    lines = [
-        "#!/bin/bash",
-        "set -e",
-        "pip install pygrid-tpu",
-        f"export DATABASE_URL={shlex.quote(config.db.url)}",
-    ]
-    if config.tpu.num_hosts > 1:
-        # one server process per TPU worker; jax.distributed picks up the
-        # coordinator from the TPU metadata (worker 0)
-        lines.append("export PYGRID_TPU_MULTIHOST=1")
-    lines.append(f"exec {cmd}")
-    return "\n".join(lines) + "\n"
+    # one server process per TPU worker on multi-host slices;
+    # jax.distributed picks up the coordinator from the TPU metadata
+    # (worker 0)
+    extra = {"PYGRID_TPU_MULTIHOST": "1"} if config.tpu.num_hosts > 1 else None
+    return bootstrap_script(config, extra_env=extra)
 
 
 class GCPServerfull(Provider):

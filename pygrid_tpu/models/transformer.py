@@ -15,7 +15,7 @@ sequence-parallel over a mesh — the model code does not change.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Sequence
+from typing import Any, Callable, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +64,8 @@ def init(key: jax.Array, cfg: TransformerConfig = TransformerConfig()) -> list[j
 
 
 def _ln(x, scale, bias, eps=1e-6):
+    # norm statistics always in f32 — bf16 mean/variance drifts
+    x = x.astype(jnp.float32)
     mu = jnp.mean(x, -1, keepdims=True)
     var = jnp.var(x, -1, keepdims=True)
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
@@ -75,6 +77,7 @@ def apply(
     cfg: TransformerConfig = TransformerConfig(),
     attn_fn: Callable | None = None,
     remat: bool = False,
+    compute_dtype: Any | None = None,
 ) -> jax.Array:
     """Logits [B, L, vocab] for int tokens [B, L]; causal.
 
@@ -82,11 +85,22 @@ def apply(
     activations (QKV, attention internals, the d_ff MLP) are recomputed in
     the backward pass instead of held in HBM. Per-layer residuals are
     still stored, so memory remains O(layers·L·d) but with a ~12× smaller
-    constant — the standard FLOPs-for-memory trade for long context."""
+    constant — the standard FLOPs-for-memory trade for long context.
+
+    ``compute_dtype="bfloat16"`` runs the matmul path in bf16 (params
+    stay float32; weights/activations cast at use — standard mixed
+    precision, feeding the MXU its native dtype) while layer norms and
+    the softmax/loss stay float32. On a v5e this roughly doubles
+    training throughput at these sizes (bench_fed_transformer)."""
     attn_fn = attn_fn or attention
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def c(x: jax.Array) -> jax.Array:
+        return x.astype(cd) if cd is not None else x
+
     embed, pos = params[0], params[1]
     B, L = tokens.shape
-    h = embed[tokens] + pos[:L]
+    h = c(embed[tokens] + pos[:L])
     idx = 2
     dh = cfg.d_model // cfg.n_heads
 
@@ -94,21 +108,25 @@ def apply(
         (ln1_s, ln1_b, wq, wk, wv, wo, ln2_s, ln2_b, w1, b1, w2, b2) = (
             layer_params
         )
-        x = _ln(h, ln1_s, ln1_b)
-        q = (x @ wq).reshape(B, L, cfg.n_heads, dh)
-        k = (x @ wk).reshape(B, L, cfg.n_heads, dh)
-        v = (x @ wv).reshape(B, L, cfg.n_heads, dh)
+        x = c(_ln(h, ln1_s, ln1_b))
+        q = (x @ c(wq)).reshape(B, L, cfg.n_heads, dh)
+        k = (x @ c(wk)).reshape(B, L, cfg.n_heads, dh)
+        v = (x @ c(wv)).reshape(B, L, cfg.n_heads, dh)
         a = attn_fn(q, k, v, causal=True).reshape(B, L, cfg.d_model)
-        h = h + a @ wo
-        x = _ln(h, ln2_s, ln2_b)
-        return h + jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+        h = h + c(a) @ c(wo)
+        x = c(_ln(h, ln2_s, ln2_b))
+        return h + c(jax.nn.gelu(x @ c(w1) + c(b1))) @ c(w2) + c(b2)
 
     block_fn = jax.checkpoint(block) if remat else block
     for _ in range(cfg.n_layers):
         h = block_fn(h, tuple(params[idx : idx + PARAMS_PER_LAYER]))
         idx += PARAMS_PER_LAYER
     h = _ln(h, params[idx], params[idx + 1])
-    return h @ embed.T
+    # logits accumulate in f32 regardless of the compute dtype — vocab
+    # softmax is where bf16 resolution actually bites
+    return jnp.dot(
+        c(h), c(embed).T, preferred_element_type=jnp.float32
+    )
 
 
 def loss_and_acc(
@@ -118,9 +136,12 @@ def loss_and_acc(
     cfg: TransformerConfig = TransformerConfig(),
     attn_fn: Callable | None = None,
     remat: bool = False,
+    compute_dtype: Any | None = None,
 ):
     """Token-level CE (int targets y [B, L]) + accuracy."""
-    logits = apply(params, X, cfg, attn_fn, remat=remat)
+    logits = apply(
+        params, X, cfg, attn_fn, remat=remat, compute_dtype=compute_dtype
+    )
     logp = jax.nn.log_softmax(logits)
     loss = -jnp.mean(jnp.take_along_axis(logp, y[..., None], axis=-1))
     acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
@@ -131,12 +152,20 @@ def make_training_step(
     cfg: TransformerConfig = TransformerConfig(),
     attn_fn: Callable | None = None,
     remat: bool = False,
+    compute_dtype: Any | None = None,
 ) -> Callable:
-    """Plan-traceable SGD step: (X, y, lr, *params) -> (loss, acc, *new)."""
+    """Plan-traceable SGD step: (X, y, lr, *params) -> (loss, acc, *new).
+
+    ``compute_dtype`` (see :func:`apply`): mixed-precision training —
+    float32 master params, bf16 matmul path, f32 gradients (the casts
+    are differentiable; grads come back f32 because params are f32)."""
 
     def training_step(X, y, lr, *params):
         (loss, acc), grads = jax.value_and_grad(
-            lambda p: loss_and_acc(p, X, y, cfg, attn_fn, remat=remat),
+            lambda p: loss_and_acc(
+                p, X, y, cfg, attn_fn, remat=remat,
+                compute_dtype=compute_dtype,
+            ),
             has_aux=True,
         )(list(params))
         new_params = [p - lr * g for p, g in zip(params, grads)]

@@ -79,7 +79,10 @@ async def ws_handler(request: web.Request) -> web.StreamResponse:
             if response is not None:
                 if isinstance(message, dict) and message.get("request_id"):
                     response["request_id"] = message["request_id"]
-                await ws.send_str(json.dumps(response))
+                try:
+                    await ws.send_str(json.dumps(response))
+                except (ConnectionError, RuntimeError):
+                    break  # peer vanished mid-handler — not a server error
     finally:
         for proxy in ctx.proxies.values():
             if proxy.socket is ws:

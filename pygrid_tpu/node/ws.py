@@ -50,10 +50,15 @@ async def ws_handler(request: web.Request) -> web.StreamResponse:
             response = await loop.run_in_executor(
                 None, route_requests, ctx, payload, conn
             )
-            if isinstance(response, (bytes, bytearray)):
-                await ws.send_bytes(bytes(response))
-            elif response is not None:
-                await ws.send_str(response)
+            try:
+                if isinstance(response, (bytes, bytearray)):
+                    await ws.send_bytes(bytes(response))
+                elif response is not None:
+                    await ws.send_str(response)
+            except (ConnectionError, RuntimeError):
+                # the peer vanished while the handler ran — a dropped
+                # response to a dropped client is not a server error
+                break
     finally:
         _handler_of(ctx).remove(ws)
     return ws

@@ -40,15 +40,30 @@ def attention(
     causal: bool = False,
     scale: float | None = None,
 ) -> jax.Array:
-    """Plain full attention, [B, L, H, D] — the single-device reference."""
+    """Plain full attention, [B, L, H, D] — the single-device reference.
+
+    Scores and softmax always accumulate in float32 (matching the flash
+    kernel's ``preferred_element_type``): with bf16 inputs a bf16
+    softmax denominator drifts as L grows. Output returns at the input
+    dtype."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k,
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
     if causal:
         L, Lk = s.shape[-2], s.shape[-1]
         mask = jnp.arange(L)[:, None] >= jnp.arange(Lk)[None, :]
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
 
 
 def _block_accumulate(q, k_blk, v_blk, o, l, m, scale, q_pos, k_pos, causal):

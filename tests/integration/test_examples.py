@@ -82,3 +82,9 @@ def test_async_fl_example():
     result = _run("async_fl.py", "--spawn")
     assert result.returncode == 0, result.stderr
     assert "async FL OK" in result.stdout
+
+
+def test_fed_transformer_example():
+    result = _run("fed_transformer.py")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "federated transformer" in result.stdout
