@@ -56,12 +56,11 @@ def main() -> int:
         compute_dtype=None if on_cpu else "bfloat16",
     )
 
-    # task: each client holds sequences drawn from ITS OWN token shift —
-    # non-iid shards whose next-token rule is learnable only jointly
+    # task: one base corpus, each client holding ITS OWN token shift of
+    # it — non-iid shards whose next-token rule is learnable only jointly
     rng = np.random.default_rng(0)
-    base = rng.integers(0, cfg.vocab, (K, B, L + 1))
-    for k in range(K):
-        base[k] = (base[0] + k) % cfg.vocab
+    corpus = rng.integers(0, cfg.vocab, (B, L + 1))
+    base = (corpus[None] + np.arange(K)[:, None, None]) % cfg.vocab
     X = jnp.asarray(base[..., :-1])
     y = jnp.asarray(base[..., 1:])
 

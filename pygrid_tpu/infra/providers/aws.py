@@ -148,7 +148,8 @@ class AWSServerless(Provider):
     container image is a terraform variable (``-var image_uri=...``):
     it must live in ECR and bundle the AWS Lambda Web Adapter (the
     request/response bridge container Lambdas need to front an HTTP
-    server; ``AWS_LWA_PORT`` is wired for it).
+    server; ``AWS_LWA_PORT`` is wired for it) — the repo's
+    ``Dockerfile.lambda`` builds exactly that image.
 
     Scope honesty: a Function URL speaks request/response HTTP only —
     NO WebSockets. The node's full model-centric flow has HTTP mirrors

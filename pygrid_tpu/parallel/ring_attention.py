@@ -70,9 +70,18 @@ def _block_accumulate(q, k_blk, v_blk, o, l, m, scale, q_pos, k_pos, causal):
     """Online-softmax accumulation of one K/V block into (o, l, m).
 
     o: [B,H,Lq,D] running (unnormalised) output, l: [B,H,Lq] running softmax
-    denominator, m: [B,H,Lq] running max. Standard flash-attention recurrence.
+    denominator, m: [B,H,Lq] running max. Standard flash-attention
+    recurrence; scores and the running statistics accumulate in float32
+    regardless of input dtype (same contract as the dense reference and
+    the Pallas kernel — a bf16 denominator drifts as L grows).
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    s = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk,
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask[None, None], s, _NEG)
@@ -80,7 +89,10 @@ def _block_accumulate(q, k_blk, v_blk, o, l, m, scale, q_pos, k_pos, causal):
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
     l_new = l * alpha + jnp.sum(p, axis=-1)
-    o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
+    )
     return o_new, l_new, m_new
 
 
@@ -138,13 +150,19 @@ def ring_attention(
 
         # fresh accumulators are replication-typed; mark them device-varying
         # so the fori_loop carry matches the ppermute-varying K/V blocks
-        o = lax.pcast(jnp.zeros((B, H, Lq, D), q.dtype), axis, to="varying")
-        l = lax.pcast(jnp.zeros((B, H, Lq), q.dtype), axis, to="varying")
-        m = lax.pcast(jnp.full((B, H, Lq), _NEG, q.dtype), axis, to="varying")
+        # running stats in f32 regardless of q.dtype (see _block_accumulate)
+        o = lax.pcast(
+            jnp.zeros((B, H, Lq, D), jnp.float32), axis, to="varying"
+        )
+        l = lax.pcast(jnp.zeros((B, H, Lq), jnp.float32), axis, to="varying")
+        m = lax.pcast(
+            jnp.full((B, H, Lq), _NEG, jnp.float32), axis, to="varying"
+        )
         # p_sz-1 rotate steps in the loop; the last block needs no ppermute
         k, v, o, l, m = lax.fori_loop(0, p_sz - 1, body, (k, v, o, l, m))
         o, l, m = accumulate(p_sz - 1, k, v, o, l, m)
-        return jnp.einsum("bhqd->bqhd", o / l[..., None])
+        out = jnp.einsum("bhqd->bqhd", o / l[..., None])
+        return out.astype(q.dtype)
 
     spec = P(None, axis, None, None)
     return shard_map(

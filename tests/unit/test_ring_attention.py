@@ -191,3 +191,41 @@ def test_transformer_mixed_precision_trains():
         p = list(out[2:])
     assert all(q.dtype == jnp.float32 for q in p)  # master weights intact
     assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_attention_bf16_inputs_f32_softmax(mesh):
+    """bf16 q/k/v: scores/softmax accumulate in f32 (the documented
+    contract), so the result tracks the f32 reference to bf16 input
+    resolution — and ring attention matches under the same dtype."""
+    import numpy as np
+
+    from pygrid_tpu.parallel.ring_attention import attention, ring_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    B, L, H, D = 2, 64, 4, 16
+    q32 = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k32 = jax.random.normal(ks[1], (B, L, H, D), jnp.float32)
+    v32 = jax.random.normal(ks[2], (B, L, H, D), jnp.float32)
+    ref = attention(q32, k32, v32, causal=True)
+    out16 = attention(
+        q32.astype(jnp.bfloat16),
+        k32.astype(jnp.bfloat16),
+        v32.astype(jnp.bfloat16),
+        causal=True,
+    )
+    assert out16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out16, np.float32), np.asarray(ref), atol=0.04
+    )
+
+    ring16 = ring_attention(
+        q32.astype(jnp.bfloat16),
+        k32.astype(jnp.bfloat16),
+        v32.astype(jnp.bfloat16),
+        mesh,
+        causal=True,
+    )
+    assert ring16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(ring16, np.float32), np.asarray(ref), atol=0.04
+    )
