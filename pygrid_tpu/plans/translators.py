@@ -144,6 +144,68 @@ def _conv(a, b, params):
     )
 
 
+def _window_init(dtype) -> Any:
+    """Identity for a max reduction at this dtype."""
+    if np.issubdtype(np.dtype(dtype), np.floating):
+        return -np.inf
+    return np.iinfo(np.dtype(dtype)).min
+
+
+def _reduce_window_max(a, p):
+    return lax.reduce_window(
+        a,
+        jnp.asarray(_window_init(a.dtype), a.dtype),
+        lax.max,
+        window_dimensions=_dims(p["window_dimensions"]),
+        window_strides=_dims(p["window_strides"]),
+        padding=[tuple(_dims(q)) for q in p["padding"]],
+        base_dilation=_dims(p["base_dilation"]),
+        window_dilation=_dims(p["window_dilation"]),
+    )
+
+
+def _select_and_scatter_add(source, operand, p):
+    """Scatter ``source`` into the positions a windowed max selects —
+    which is exactly the VJP of reduce_window_max w.r.t. its operand, so
+    the public autodiff machinery IS the implementation (no private
+    primitive binds)."""
+    sel = p.get("select_prim")
+    if not (isinstance(sel, dict) and sel.get("__repr__") == "ge"):
+        raise PlanTranslationError(
+            f"select_and_scatter_add: unsupported select {sel!r}"
+        )
+
+    def pool(x):
+        return lax.reduce_window(
+            x,
+            jnp.asarray(_window_init(x.dtype), x.dtype),
+            lax.max,
+            window_dimensions=_dims(p["window_dimensions"]),
+            window_strides=_dims(p["window_strides"]),
+            padding=[tuple(_dims(q)) for q in p["padding"]],
+        )
+
+    # hostile-params gate BEFORE any execution: the pooled shape must
+    # match the source (and is bounded — a huge-padding envelope must
+    # fail typed, not allocate inside the vjp's forward pass)
+    try:
+        pooled = jax.eval_shape(pool, jax.ShapeDtypeStruct(
+            np.shape(operand), np.asarray(operand).dtype
+        ))
+    except Exception as err:  # noqa: BLE001 — remote-supplied params
+        raise PlanTranslationError(
+            f"select_and_scatter_add: invalid params: {err}"
+        ) from err
+    _bounded_elems(pooled.shape, "select_and_scatter_add (window grid)")
+    if tuple(pooled.shape) != tuple(np.shape(source)):
+        raise PlanTranslationError(
+            f"select_and_scatter_add: source shape {np.shape(source)} "
+            f"does not match window grid {pooled.shape}"
+        )
+    _, vjp = jax.vjp(pool, operand)
+    return vjp(source)[0]
+
+
 def _reduce(fn):
     def run(x, params):
         return fn(x, axis=_dims(params["axes"]))
@@ -230,6 +292,8 @@ _INTERP_TABLE: dict[str, Any] = {
     else jnp.where(args[0], args[2], args[1]),
     "dot_general": _dot_general,
     "conv_general_dilated": _conv,
+    "reduce_window_max": _reduce_window_max,
+    "select_and_scatter_add": _select_and_scatter_add,
     "concatenate": lambda *args: lax.concatenate(
         list(args[:-1]), int(args[-1]["dimension"])
     ),
@@ -310,6 +374,159 @@ def _np_reduce(fn):
         return fn(x, axis=_dims(params["axes"]) or None)
 
     return run
+
+
+def _np_windows(a: np.ndarray, p: dict, pad_value) -> tuple[np.ndarray, tuple]:
+    """Strided sliding windows of ``a`` per reduce-window params: returns
+    (patches [out_shape… + window_dims…], padded input shape). Supports
+    window_dilation via strided slicing of the window dims; base_dilation
+    must be 1 (typed error — nothing in the plan corpus emits it)."""
+    wd = _dims(p["window_dimensions"])
+    ws = _dims(p["window_strides"])
+    pads = [tuple(_dims(q)) for q in p["padding"]]
+    wdil = _dims(p.get("window_dilation") or [1] * a.ndim)
+    bdil = _dims(p.get("base_dilation") or [1] * a.ndim)
+    if any(d != 1 for d in bdil):
+        raise PlanTranslationError(
+            "reduce_window: base_dilation != 1 not supported by the "
+            "numpy backend"
+        )
+    _bounded_elems(
+        [d + lo + hi for d, (lo, hi) in zip(a.shape, pads)],
+        "reduce_window (padded input)",
+    )
+    padded = np.pad(a, pads, constant_values=pad_value)
+    eff_wd = tuple((w - 1) * d + 1 for w, d in zip(wd, wdil))
+    view = np.lib.stride_tricks.sliding_window_view(padded, eff_wd)
+    # stride the output positions, then dilate the window dims
+    out_sel = tuple(slice(None, None, s) for s in ws)
+    win_sel = tuple(slice(None, None, d) for d in wdil)
+    return view[out_sel + win_sel], padded.shape
+
+
+def _np_reduce_window_max(a, p):
+    patches, _ = _np_windows(a, p, _window_init(a.dtype))
+    return patches.max(axis=tuple(range(a.ndim, 2 * a.ndim)))
+
+
+def _np_select_and_scatter_add(source, operand, p):
+    """Numpy twin of the maxpool VJP: route each source value to the
+    first-maximum position of its window (XLA's 'ge' scan-order tie
+    rule = argmax over row-major window order)."""
+    sel = p.get("select_prim")
+    if not (isinstance(sel, dict) and sel.get("__repr__") == "ge"):
+        raise PlanTranslationError(
+            f"select_and_scatter_add: unsupported select {sel!r}"
+        )
+    n = operand.ndim
+    pads = [tuple(_dims(q)) for q in p["padding"]]
+    patches, padded_shape = _np_windows(
+        operand, {**p, "window_dilation": [1] * n}, _window_init(operand.dtype)
+    )
+    out_shape = patches.shape[:n]
+    if tuple(np.shape(source)) != out_shape:
+        raise PlanTranslationError(
+            f"select_and_scatter_add: source shape {np.shape(source)} "
+            f"does not match window grid {out_shape}"
+        )
+    wd = patches.shape[n:]
+    flat = patches.reshape(out_shape + (-1,))
+    arg = flat.argmax(axis=-1)                      # first max, row-major
+    # absolute (padded) coordinates of each selected element
+    win_off = np.unravel_index(arg, wd)             # n arrays, out_shape
+    ws = _dims(p["window_strides"])
+    out_grid = np.meshgrid(
+        *[np.arange(s) for s in out_shape], indexing="ij"
+    )
+    scatter = np.zeros(padded_shape, dtype=source.dtype)
+    idx = tuple(g * s + w for g, s, w in zip(out_grid, ws, win_off))
+    np.add.at(scatter, idx, source)
+    # crop the padding back off
+    crop = tuple(
+        slice(lo, lo + dim) for (lo, _), dim in zip(pads, operand.shape)
+    )
+    return scatter[crop]
+
+
+def _np_conv(a, b, p):
+    """conv_general_dilated on numpy: normalize to (N, C, *spatial) ×
+    (O, I, *spatial) via the dimension numbers, dilate/pad explicitly,
+    then one sliding-window tensordot per feature group. Covers the
+    forward AND both backward convs the training plans emit (input grads
+    arrive as lhs_dilation, weight grads as a transposed conv)."""
+    dn = [tuple(_dims(d)) for d in p["dimension_numbers"]]
+    lhs_spec, rhs_spec, out_spec = dn
+    if int(p.get("batch_group_count", 1)) != 1:
+        raise PlanTranslationError(
+            "conv: batch_group_count != 1 not supported by numpy backend"
+        )
+    groups = int(p.get("feature_group_count", 1))
+    a = np.transpose(a, lhs_spec)                   # [N, C, *spatial]
+    b = np.transpose(b, rhs_spec)                   # [O, I, *spatial]
+    nsp = a.ndim - 2
+    strides = _dims(p["window_strides"])
+    pads = [tuple(_dims(q)) for q in p["padding"]]
+    ldil = _dims(p.get("lhs_dilation") or [1] * nsp)
+    rdil = _dims(p.get("rhs_dilation") or [1] * nsp)
+
+    def dilate(x, dil, axes):
+        for ax, d in zip(axes, dil):
+            if d == 1:
+                continue
+            shape = list(x.shape)
+            shape[ax] = (shape[ax] - 1) * d + 1 if shape[ax] else 0
+            _bounded_elems(shape, "conv (dilated operand)")
+            out = np.zeros(shape, x.dtype)
+            out[tuple(
+                slice(None, None, d) if i == ax else slice(None)
+                for i in range(x.ndim)
+            )] = x
+            x = out
+        return x
+
+    a = dilate(a, ldil, range(2, 2 + nsp))
+    b = dilate(b, rdil, range(2, 2 + nsp))
+    # negative padding = cropping (conv transpose emits it); a crop that
+    # consumes the whole dim yields an EMPTY dim, exactly like lax
+    crop = []
+    for i, (lo, hi) in enumerate(pads):
+        start = max(0, -lo)
+        stop = max(start, a.shape[2 + i] - max(0, -hi))
+        crop.append(slice(start, stop))
+    a = a[(slice(None), slice(None)) + tuple(crop)]
+    pos_pads = [(max(0, lo), max(0, hi)) for lo, hi in pads]
+    _bounded_elems(
+        list(a.shape[:2])
+        + [d + lo + hi for d, (lo, hi) in zip(a.shape[2:], pos_pads)],
+        "conv (padded operand)",
+    )
+    a = np.pad(a, [(0, 0), (0, 0)] + pos_pads)
+    kernel_sp = b.shape[2:]
+    view = np.lib.stride_tricks.sliding_window_view(
+        a, kernel_sp, axis=tuple(range(2, 2 + nsp))
+    )  # [N, C, *out_sp, *kernel_sp]
+    view = view[
+        (slice(None), slice(None))
+        + tuple(slice(None, None, s) for s in strides)
+    ]
+    cin_g = a.shape[1] // groups
+    cout_g = b.shape[0] // groups
+    outs = []
+    for g in range(groups):
+        vg = view[:, g * cin_g: (g + 1) * cin_g]
+        bg = b[g * cout_g: (g + 1) * cout_g]
+        # [N, C, *out, *k] × [O, C, *k] → [N, *out, O]
+        og = np.tensordot(
+            vg, bg, axes=([1] + list(range(2 + nsp, 2 + 2 * nsp)),
+                          [1] + list(range(2, 2 + nsp))),
+        )
+        outs.append(og)
+    out = np.concatenate(outs, axis=-1)             # [N, *out_sp, O]
+    out = np.moveaxis(out, -1, 1)                   # [N, O, *out_sp]
+    # place result axes per out_spec: out_spec[i] = destination axis of
+    # canonical axis i
+    inv = np.argsort(out_spec)
+    return np.transpose(out, inv)
 
 
 def _np_select_n(*args):
@@ -412,6 +629,9 @@ _NUMPY_TABLE: dict[str, Any] = {
     ),
     "select_n": _np_select_n,
     "dot_general": _np_dot_general,
+    "conv_general_dilated": _np_conv,
+    "reduce_window_max": _np_reduce_window_max,
+    "select_and_scatter_add": _np_select_and_scatter_add,
     "concatenate": lambda *args: np.concatenate(
         list(args[:-1]), int(args[-1]["dimension"])
     ),
@@ -456,9 +676,34 @@ _ALLOC_SHAPE_PARAMS = {
 
 #: ops whose OUTPUT can dwarf their inputs even when every operand is
 #: within bounds (outer-product dot_general, dilated conv, a concatenate
-#: repeating one bound-passing operand many times) — their output shape
-#: is derived abstractly (eval_shape allocates nothing) and bounded
-_EXPANSION_OPS = ("dot_general", "conv_general_dilated", "concatenate")
+#: repeating one bound-passing operand many times, padding-inflated
+#: window reductions) — their output shape is derived abstractly
+#: (eval_shape allocates nothing) and bounded. Backend-side INTERMEDIATES
+#: (padded/dilated arrays the numpy path materializes) are additionally
+#: bounded at their allocation sites via _bounded_elems.
+_EXPANSION_OPS = (
+    "dot_general",
+    "conv_general_dilated",
+    "concatenate",
+    "reduce_window_max",
+)
+# select_and_scatter_add is NOT in _EXPANSION_OPS: eval_shape cannot
+# trace through the jax.vjp implementation, and its output is always
+# operand-shaped (already a live, bounded array); the internal pool
+# shape is validated inside _select_and_scatter_add itself.
+
+
+def _bounded_elems(shape, what: str) -> None:
+    n = 1
+    for d in shape:
+        if d < 0:
+            raise PlanTranslationError(f"{what}: negative dim in {shape}")
+        n *= int(d)
+    if n > MAX_OPLIST_ELEMENTS:
+        raise PlanTranslationError(
+            f"{what}: {n} elements exceeds the "
+            f"{MAX_OPLIST_ELEMENTS}-element allocation bound"
+        )
 
 
 def _check_alloc(op: str, params: dict, invals: tuple = ()) -> None:

@@ -187,3 +187,189 @@ def test_oplist_numpy_backend_unknown_op_is_typed_error():
     }
     with pytest.raises(PlanTranslationError, match="no_such_op"):
         run_oplist(bogus, np.ones(2), backend="numpy")
+
+
+def test_oplist_runs_cnn_training_plan_both_backends():
+    """The portable dialect covers the CNN training plan — conv
+    forward/backward (incl. the lhs-dilated transpose conv the input
+    gradient emits), maxpool (reduce_window_max) and its scatter
+    gradient (select_and_scatter_add) — on the jax interpreter AND on a
+    numpy-only client (the tfjs-analog consumer, reference
+    plan_manager.py:119-149)."""
+    import jax
+
+    from pygrid_tpu.models import cnn
+    from pygrid_tpu.plans.plan import Plan
+
+    params = [np.asarray(p) for p in cnn.init(jax.random.PRNGKey(0))]
+    rng = np.random.RandomState(7)
+    X = rng.rand(2, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 2)]
+    plan = Plan(name="training_plan", fn=cnn.training_step)
+    plan.build(X, y, np.float32(0.1), *params)
+    ref = cnn.training_step(X, y, np.float32(0.1), *params)
+    oplist = serde.deserialize(serde.serialize(plan.oplist))
+    for backend in ("jax", "numpy"):
+        out = run_oplist(
+            oplist, X, y, np.float32(0.1), *params, backend=backend
+        )
+        for a, b in zip(ref, out):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+
+def test_numpy_windowed_ops_match_lax():
+    """Direct parity of the three windowed numpy ops vs lax on shapes the
+    plan corpus doesn't hit (odd strides, asymmetric padding, window
+    dilation, grouped + dilated conv)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pygrid_tpu.plans.translators import (
+        _np_conv,
+        _np_reduce_window_max,
+        _np_select_and_scatter_add,
+    )
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 9, 11, 3).astype(np.float32)
+    p = {
+        "window_dimensions": [1, 3, 2, 1],
+        "window_strides": [1, 2, 3, 1],
+        "padding": [[0, 0], [1, 2], [0, 1], [0, 0]],
+        "base_dilation": [1, 1, 1, 1],
+        "window_dilation": [1, 2, 1, 1],
+    }
+    want = lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        tuple(p["window_dimensions"]), tuple(p["window_strides"]),
+        [tuple(q) for q in p["padding"]],
+        window_dilation=tuple(p["window_dilation"]),
+    )
+    np.testing.assert_allclose(_np_reduce_window_max(x, p), np.asarray(want))
+
+    # select_and_scatter_add vs the VJP of maxpool
+    p2 = {
+        "select_prim": {"__repr__": "ge"},
+        "window_dimensions": [1, 2, 2, 1],
+        "window_strides": [1, 2, 2, 1],
+        "padding": [[0, 0], [1, 0], [0, 1], [0, 0]],
+    }
+    src_shape = lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        tuple(p2["window_dimensions"]), tuple(p2["window_strides"]),
+        [tuple(q) for q in p2["padding"]],
+    ).shape
+    src = rng.randn(*src_shape).astype(np.float32)
+
+    def pool(v):
+        return lax.reduce_window(
+            v, -jnp.inf, lax.max,
+            tuple(p2["window_dimensions"]), tuple(p2["window_strides"]),
+            [tuple(q) for q in p2["padding"]],
+        )
+
+    _, vjp = jax.vjp(pool, jnp.asarray(x))
+    want2 = vjp(jnp.asarray(src))[0]
+    np.testing.assert_allclose(
+        _np_select_and_scatter_add(src, x, p2), np.asarray(want2)
+    )
+
+    # grouped, dilated, strided conv with asymmetric padding
+    lhs = rng.randn(2, 10, 12, 4).astype(np.float32)
+    ker = rng.randn(3, 3, 2, 6).astype(np.float32)  # HWIO, groups=2
+    dn = lax.conv_dimension_numbers(lhs.shape, ker.shape, ("NHWC", "HWIO", "NHWC"))
+    kwargs = dict(
+        window_strides=(2, 1),
+        padding=[(1, 2), (0, 1)],
+        lhs_dilation=(1, 2),
+        rhs_dilation=(2, 1),
+        dimension_numbers=dn,
+        feature_group_count=2,
+    )
+    want3 = lax.conv_general_dilated(lhs, ker, **kwargs)
+    p3 = {
+        "window_strides": [2, 1],
+        "padding": [[1, 2], [0, 1]],
+        "lhs_dilation": [1, 2],
+        "rhs_dilation": [2, 1],
+        "dimension_numbers": [list(dn.lhs_spec), list(dn.rhs_spec), list(dn.out_spec)],
+        "feature_group_count": 2,
+        "batch_group_count": 1,
+    }
+    np.testing.assert_allclose(
+        _np_conv(lhs, ker, p3), np.asarray(want3), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_numpy_scatter_tie_break_matches_lax():
+    """Repeated values (post-ReLU zeros, quantized inputs) force ties in
+    every window — the first-max row-major rule must match XLA's 'ge'
+    scan order or maxpool gradients silently diverge between backends."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pygrid_tpu.plans.translators import _np_select_and_scatter_add
+
+    rng = np.random.RandomState(1)
+    x = rng.randint(0, 3, (2, 8, 8, 2)).astype(np.float32)  # heavy ties
+    p = {
+        "select_prim": {"__repr__": "ge"},
+        "window_dimensions": [1, 2, 2, 1],
+        "window_strides": [1, 2, 2, 1],
+        "padding": [[0, 0], [0, 0], [0, 0], [0, 0]],
+    }
+
+    def pool(v):
+        return lax.reduce_window(
+            v, -jnp.inf, lax.max,
+            tuple(p["window_dimensions"]), tuple(p["window_strides"]),
+            [tuple(q) for q in p["padding"]],
+        )
+
+    src = rng.randn(*pool(jnp.asarray(x)).shape).astype(np.float32)
+    _, vjp = jax.vjp(pool, jnp.asarray(x))
+    want = vjp(jnp.asarray(src))[0]
+    np.testing.assert_allclose(
+        _np_select_and_scatter_add(src, x, p), np.asarray(want)
+    )
+
+
+def test_windowed_ops_hostile_params_bounded():
+    """Huge padding/dilation through the windowed ops must fail typed on
+    both backends (allocation bound), never attempt the allocation."""
+    from pygrid_tpu.utils.exceptions import PlanTranslationError
+
+    big = 1 << 40
+    evil_pool = {
+        "constvars": [], "consts": [], "invars": [0],
+        "eqns": [{"op": "reduce_window_max", "params": {
+            "window_dimensions": [1], "window_strides": [1],
+            "padding": [[0, big]], "base_dilation": [1],
+            "window_dilation": [1],
+        }, "in": [{"var": 0}], "out": [1]}],
+        "outvars": [{"var": 1}],
+    }
+    for backend in ("numpy", "jax"):
+        with pytest.raises(PlanTranslationError, match="allocation bound|invalid params"):
+            run_oplist(evil_pool, np.ones(4, np.float32), backend=backend)
+
+    # lhs-dilated conv whose intermediate (not output) explodes
+    from pygrid_tpu.plans.translators import _np_conv
+
+    lhs = np.ones((1, 4, 1), np.float32)    # NWC-ish 1-spatial-dim conv
+    ker = np.ones((1, 1, 1), np.float32)
+    p = {
+        "window_strides": [1],
+        "padding": [[0, -(3 * (1 << 27))]],
+        "lhs_dilation": [1 << 27],
+        "rhs_dilation": [1],
+        "dimension_numbers": [[0, 2, 1], [2, 1, 0], [0, 2, 1]],
+        "feature_group_count": 1,
+        "batch_group_count": 1,
+    }
+    with pytest.raises(PlanTranslationError, match="allocation bound"):
+        _np_conv(lhs, ker, p)
