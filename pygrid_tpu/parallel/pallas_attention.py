@@ -124,6 +124,16 @@ def _flash_kernel(
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
+def _struct(shape, dtype, vma):
+    """out_shape struct carrying the inputs' varying mesh axes: under
+    shard_map the outputs inherit the inputs' vma, and check_vma rejects
+    a pallas_call whose out_shape doesn't declare it. The getattr guard
+    on the caller side exists because the vma API is still in flux."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pad_to(x: jax.Array, length: int, axis: int) -> jax.Array:
     pad = length - x.shape[axis]
     if pad == 0:
@@ -173,14 +183,8 @@ def _fwd_impl(
         memory_space=pltpu.VMEM,
     )
 
-    # under shard_map the outputs inherit the inputs' varying mesh axes —
-    # the vma must be declared on the out_shape or check_vma rejects it
     vma = getattr(jax.typeof(qf), "vma", None)
-
-    def struct(shape, dtype):
-        if vma:
-            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-        return jax.ShapeDtypeStruct(shape, dtype)
+    struct = partial(_struct, vma=vma)
 
     out, lse = pl.pallas_call(
         partial(
@@ -210,94 +214,345 @@ def _fwd_impl(
     return out, lse[:, :Lq, 0]
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, interpret, block_q, block_k, precision):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(
+    q, k, v, causal, scale, interpret, block_q, block_k, precision,
+    bwd_block_q, bwd_block_k,
+):
     out, _ = _fwd_impl(
         q, k, v, causal, scale, interpret, block_q, block_k, precision
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret, block_q, block_k, precision):
+def _flash_fwd(
+    q, k, v, causal, scale, interpret, block_q, block_k, precision,
+    bwd_block_q, bwd_block_k,
+):
     out, lse = _fwd_impl(
         q, k, v, causal, scale, interpret, block_q, block_k, precision
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(
-    causal, scale, interpret, block_q, block_k, precision, residuals, do
+#: backward block size defaults — (bq, bk) f32 score/probability
+#: intermediates appear 4× per step, so 512×512 (4 MB of VMEM
+#: intermediates) instead of the forward's 512×1024; both kernels clamp
+#: down for short sequences. Deliberately independent of the forward's
+#: block args (the backward's VMEM budget — 2 grad accumulators + 4 f32
+#: tiles — is its own problem); override per call via
+#: ``flash_attention(..., bwd_block_q=..., bwd_block_k=...)``, which is
+#: jit-cache-keyed like every other static arg.
+BWD_BLOCK_Q = 512
+BWD_BLOCK_K = 512
+
+
+def _mask(qi, ki, block_q, block_k, lq_true, lk_true, causal,
+          transposed=False):
+    """Validity mask for one (q-block, k-block) score tile: pad queries
+    and pad keys contribute nothing; causal keeps the lower triangle.
+    ``transposed=True`` lays the tile out as [bk, bq] (k on sublanes, q
+    on lanes — the dkv kernel's orientation); the causal/pad semantics
+    are identical, keeping one source of truth for both kernels."""
+    shape = (block_k, block_q) if transposed else (block_q, block_k)
+    q_dim = 1 if transposed else 0
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, shape, q_dim)
+    k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, shape, 1 - q_dim)
+    # pad-q rows carry lse=0 from the re-pad: exp(s-0) is finite but
+    # wrong, so q validity must be part of the mask (the forward only
+    # needed k validity — its pad-q rows were sliced off)
+    valid = jnp.logical_and(q_pos < lq_true, k_pos < lk_true)
+    if causal:
+        valid = jnp.logical_and(valid, q_pos >= k_pos)
+    return valid
+
+
+def _bwd_dkv_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale, causal, lq_true, lk_true, n_q, block_q, block_k, precision,
 ):
-    """Flash backward (Dao et al. §3.1), a ``lax.scan`` over key blocks in
-    plain XLA: with the forward's per-row log-sum-exp saved,
-    P = exp(s − lse) re-derives exactly per block, so memory stays
-    O(L·block) and — because the loop is a scan, not a trace-time unroll
-    — compile time stays O(1) in sequence length. Under causal masking
-    the scan computes full-Lq blocks and masks (scan bodies need static
-    shapes, so the forward's upper-triangle block skip cannot carry over)
-    — ~2× extra MXU work on causal backward, traded for O(1) compilation
-    at the long contexts this path exists for."""
+    """dk/dv pass: grid (B·H, Lk/bk, Lq/bq), q innermost — the dk/dv
+    accumulators stay in VMEM scratch across the whole q sweep.
+
+    Everything is computed in the TRANSPOSED orientation (scores as
+    [bk, bq], k-rows on sublanes): dv = Pᵀ·dO and dk = dSᵀ·Q contract
+    the q axis, which in the row-major orientation is the sublane dim of
+    both operands — a layout Mosaic must transpose before the MXU pass.
+    With k on sublanes all four dots are lane-contracting or canonical
+    matmuls and no relayout is ever emitted. The per-q-row statistics
+    arrive as [8, bq] ROWS (lse/Δ broadcast over 8 sublanes) for the
+    same reason.
+    """
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: a (q,k) block pair strictly above the diagonal has no live
+    # lane — skip all four dots (the upper-triangle pruning the XLA scan
+    # could not express; ~2× fewer MXU FLOPs on causal backward)
+    live = (
+        (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    )
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0][:1, :]      # [1, bq] f32 row
+        delta = delta_ref[0][:1, :]  # [1, bq] f32 row
+        # sᵀ = K·Qᵀ  [bk, bq]
+        s_t = lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        ) * scale
+        valid_t = _mask(
+            qi, ki, block_q, block_k, lq_true, lk_true, causal,
+            transposed=True,
+        )
+        # exp(s - lse) ≤ 1 on live lanes (lse ≥ every s in its row); the
+        # minimum clamp keeps dead lanes from overflowing before the select
+        p_t = jnp.where(
+            valid_t, jnp.exp(jnp.minimum(s_t - lse, 0.0)), 0.0
+        )
+        # dv += Pᵀ·dO  — canonical [bk, bq]·[bq, Dp]
+        dv_acc[:] += lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+        # dpᵀ = V·dOᵀ, dsᵀ = Pᵀ ∘ (dpᵀ − Δ)·scale, dk += dSᵀ·Q
+        dp_t = lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+        ds_t = p_t * (dp_t - delta) * scale
+        dk_acc[:] += lax.dot_general(
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _write():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref, dq_acc,
+    *, scale, causal, lq_true, lk_true, n_k, block_q, block_k, precision,
+):
+    """dq pass: grid (B·H, Lq/bq, Lk/bk), k innermost — the dq
+    accumulator stays in VMEM scratch across the whole k sweep."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (
+        (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    )
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        ) * scale
+        valid = _mask(qi, ki, block_q, block_k, lq_true, lk_true, causal)
+        p = jnp.where(valid, jnp.exp(jnp.minimum(s - lse, 0.0)), 0.0)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+        ds = p * (dp - delta) * scale
+        # dq += ds·k
+        dq_acc[:] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _write():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(
+    causal, scale, interpret, block_q, block_k, precision,
+    bwd_block_q, bwd_block_k, residuals, do,
+):
+    """Flash backward (Dao et al. §3.1) as two Pallas kernels off the
+    forward's saved per-row log-sum-exp: a dk/dv pass (q innermost) and a
+    dq pass (k innermost), each with its gradient tile resident in VMEM
+    f32 scratch and bf16 operands feeding every MXU dot — the streams are
+    never up-cast to f32 in HBM. Causal block pairs strictly above the
+    diagonal skip all four dots (the pruning the forward does, which the
+    previous plain-XLA ``lax.scan`` backward could not express — it cost
+    ~2× extra MXU work and a full f32 re-materialization of q/k/v/dO).
+    Memory stays O(L·block) in both passes and compile time O(1) in L.
+    """
     q, k, v, o, lse = residuals
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     in_dtypes = (q.dtype, k.dtype, v.dtype)
 
     def to_bhld(x):
-        return (
-            x.transpose(0, 2, 1, 3)
-            .reshape(B * H, x.shape[1], D)
-            .astype(jnp.float32)
-        )
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
 
-    qf, kf, vf, of, dof = map(to_bhld, (q, k, v, o, do))
-    # D_i = rowsum(dO ∘ O) — the softmax-jacobian diagonal term
-    delta = jnp.sum(dof * of, axis=-1)  # [BH, Lq]
+    qf, kf, vf, dof = map(to_bhld, (q, k, v, do))
+    # Δ_i = rowsum(dO ∘ O) — the softmax-jacobian diagonal term (one
+    # fused XLA pass; not worth a kernel)
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * to_bhld(o).astype(jnp.float32),
+        axis=-1,
+    )  # [BH, Lq]
 
-    bk = min(block_k, pl.cdiv(Lk, 128) * 128)
+    bq = min(bwd_block_q, pl.cdiv(Lq, 128) * 128)
+    bk = min(bwd_block_k, pl.cdiv(Lk, 128) * 128)
+    Lqp = pl.cdiv(Lq, bq) * bq
     Lkp = pl.cdiv(Lk, bk) * bk
-    n_blocks = Lkp // bk
-    kf = _pad_to(kf, Lkp, 1)
-    vf = _pad_to(vf, Lkp, 1)
-    # [n_blocks, BH, bk, D] so the scan consumes one block per step
-    k_blocks = kf.reshape(kf.shape[0], n_blocks, bk, D).transpose(1, 0, 2, 3)
-    v_blocks = vf.reshape(vf.shape[0], n_blocks, bk, D).transpose(1, 0, 2, 3)
-    q_pos = jnp.arange(Lq)
-
-    def body(dq, blk):
-        bi, k_blk, v_blk = blk
-        s = jnp.einsum(
-            "nqd,nkd->nqk", qf, k_blk, precision=precision
-        ) * scale
-        k_pos = bi * bk + jnp.arange(bk)
-        valid = (k_pos < Lk)[None, :]  # pad keys contribute nothing
-        if causal:
-            valid = jnp.logical_and(valid, q_pos[:, None] >= k_pos[None, :])
-        p = jnp.where(valid[None], jnp.exp(s - lse[:, :, None]), 0.0)
-        dv_blk = jnp.einsum("nqk,nqd->nkd", p, dof, precision=precision)
-        dp = jnp.einsum("nqd,nkd->nqk", dof, v_blk, precision=precision)
-        ds = p * (dp - delta[:, :, None]) * scale
-        dq = dq + jnp.einsum(
-            "nqk,nkd->nqd", ds, k_blk, precision=precision
-        )
-        dk_blk = jnp.einsum("nqk,nqd->nkd", ds, qf, precision=precision)
-        return dq, (dk_blk, dv_blk)
-
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        body,
-        jnp.zeros_like(qf),
-        (jnp.arange(n_blocks), k_blocks, v_blocks),
+    Dp = pl.cdiv(D, MIN_D) * MIN_D
+    n_q = Lqp // bq
+    n_k = Lkp // bk
+    qf = _pad_to(_pad_to(qf, Lqp, 1), Dp, 2)
+    dof = _pad_to(_pad_to(dof, Lqp, 1), Dp, 2)
+    kf = _pad_to(_pad_to(kf, Lkp, 1), Dp, 2)
+    vf = _pad_to(_pad_to(vf, Lkp, 1), Dp, 2)
+    # per-q-row statistics in both orientations (the forward's Mosaic
+    # block-rule trick): [Lqp, 8] columns for the dq kernel, [8, Lqp]
+    # rows for the transposed dkv kernel — each reads with no relayout
+    lse8 = _pad_to(
+        jnp.broadcast_to(lse[:, :, None], (B * H, Lq, 8)), Lqp, 1
     )
-    # [n_blocks, BH, bk, D] → [BH, Lk, D]
-    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(-1, Lkp, D)[:, :Lk]
-    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(-1, Lkp, D)[:, :Lk]
+    delta8 = _pad_to(
+        jnp.broadcast_to(delta[:, :, None], (B * H, Lq, 8)), Lqp, 1
+    )
+    lse_t8 = _pad_to(
+        jnp.broadcast_to(lse[:, None, :], (B * H, 8, Lq)), Lqp, 2
+    )
+    delta_t8 = _pad_to(
+        jnp.broadcast_to(delta[:, None, :], (B * H, 8, Lq)), Lqp, 2
+    )
 
-    def back(x, dtype):
+    vma = getattr(jax.typeof(qf), "vma", None)
+    struct = partial(_struct, vma=vma)
+
+    def kv_specs(index):
+        return [
+            pl.BlockSpec((1, bk, Dp), index, memory_space=pltpu.VMEM)
+            for _ in range(2)
+        ]
+
+    dkv_q_index = lambda bh, ki, qi: (bh, qi, 0)  # noqa: E731
+    dkv_stat_index = lambda bh, ki, qi: (bh, 0, qi)  # noqa: E731
+    dk, dv = pl.pallas_call(
+        partial(
+            _bwd_dkv_kernel,
+            scale=scale, causal=causal, lq_true=Lq, lk_true=Lk, n_q=n_q,
+            block_q=bq, block_k=bk, precision=precision,
+        ),
+        grid=(B * H, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec(
+                (1, bq, Dp), dkv_q_index, memory_space=pltpu.VMEM
+            ),  # q
+            pl.BlockSpec(
+                (1, bq, Dp), dkv_q_index, memory_space=pltpu.VMEM
+            ),  # do
+            pl.BlockSpec(
+                (1, 8, bq), dkv_stat_index, memory_space=pltpu.VMEM
+            ),  # lseᵀ
+            pl.BlockSpec(
+                (1, 8, bq), dkv_stat_index, memory_space=pltpu.VMEM
+            ),  # Δᵀ
+        ] + kv_specs(lambda bh, ki, qi: (bh, ki, 0)),
+        out_specs=[
+            pl.BlockSpec(
+                (1, bk, Dp), lambda bh, ki, qi: (bh, ki, 0),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(2)
+        ],
+        out_shape=[
+            struct((B * H, Lkp, Dp), k.dtype),
+            struct((B * H, Lkp, Dp), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, Dp), jnp.float32),
+            pltpu.VMEM((bk, Dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, dof, lse_t8, delta_t8, kf, vf)
+
+    dq_q_index = lambda bh, qi, ki: (bh, qi, 0)  # noqa: E731
+    dq = pl.pallas_call(
+        partial(
+            _bwd_dq_kernel,
+            scale=scale, causal=causal, lq_true=Lq, lk_true=Lk, n_k=n_k,
+            block_q=bq, block_k=bk, precision=precision,
+        ),
+        grid=(B * H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, bq, Dp), dq_q_index, memory_space=pltpu.VMEM
+            ),  # q
+            pl.BlockSpec(
+                (1, bq, Dp), dq_q_index, memory_space=pltpu.VMEM
+            ),  # do
+            pl.BlockSpec(
+                (1, bq, 8), dq_q_index, memory_space=pltpu.VMEM
+            ),  # lse
+            pl.BlockSpec(
+                (1, bq, 8), dq_q_index, memory_space=pltpu.VMEM
+            ),  # Δ
+        ] + kv_specs(lambda bh, qi, ki: (bh, ki, 0)),
+        out_specs=pl.BlockSpec(
+            (1, bq, Dp), lambda bh, qi, ki: (bh, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=struct((B * H, Lqp, Dp), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, Dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, dof, lse8, delta8, kf, vf)
+
+    def back(x, L_true, dtype):
         return (
-            x.reshape(B, H, -1, D).transpose(0, 2, 1, 3).astype(dtype)
+            x[:, :L_true, :D]
+            .reshape(B, H, L_true, D)
+            .transpose(0, 2, 1, 3)
+            .astype(dtype)
         )
 
     return (
-        back(dq, in_dtypes[0]), back(dk, in_dtypes[1]), back(dv, in_dtypes[2])
+        back(dq, Lq, in_dtypes[0]),
+        back(dk, Lk, in_dtypes[1]),
+        back(dv, Lk, in_dtypes[2]),
     )
 
 
@@ -307,7 +562,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 @partial(
     jax.jit,
     static_argnames=(
-        "causal", "scale", "interpret", "block_q", "block_k", "precision"
+        "causal", "scale", "interpret", "block_q", "block_k", "precision",
+        "bwd_block_q", "bwd_block_k",
     ),
 )
 def flash_attention(
@@ -320,6 +576,8 @@ def flash_attention(
     block_q: int = BLOCK_Q,
     block_k: int = BLOCK_K,
     precision: lax.Precision | None = None,
+    bwd_block_q: int = BWD_BLOCK_Q,
+    bwd_block_k: int = BWD_BLOCK_K,
 ) -> jax.Array:
     """Fused attention, [B, L, H, D] (the layout `attention` uses).
 
@@ -328,9 +586,10 @@ def flash_attention(
     alignment). ``interpret=True`` runs the kernel on CPU for tests.
 
     Differentiable: the forward kernel saves each query row's
-    log-sum-exp, and a custom VJP runs the flash backward blocked over
-    key blocks — O(L·block) memory in both directions, so long-context
-    TRAINING fits where the XLA path cannot even materialize the scores.
+    log-sum-exp, and a custom VJP runs the flash backward as two Pallas
+    kernels (dk/dv and dq, ``bwd_block_q``/``bwd_block_k`` tiles) —
+    O(L·block) memory in both directions, so long-context TRAINING fits
+    where the XLA path cannot even materialize the scores.
 
     ``precision`` reaches both MXU dots: the default (None) feeds the MXU
     bf16 operands with f32 accumulation — the standard TPU trade, and
@@ -344,5 +603,6 @@ def flash_attention(
         raise ValueError("causal flash_attention requires Lq == Lk")
     scale_ = scale if scale is not None else D**-0.5
     return _flash(
-        q, k, v, causal, scale_, interpret, block_q, block_k, precision
+        q, k, v, causal, scale_, interpret, block_q, block_k, precision,
+        bwd_block_q, bwd_block_k,
     )

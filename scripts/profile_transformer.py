@@ -1,0 +1,99 @@
+"""Decompose the flagship fed-transformer round time on the real chip.
+
+Times the full training step against ablations (identity attention, XLA
+attention, fwd-only, small vocab) with the round-4 marginal-timing recipe:
+scan chains of 2 vs 10 rounds, min-of-5, slope = per-round time — immune
+to the tunnel's 20-70 ms per-call jitter.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from pygrid_tpu.models import transformer
+from pygrid_tpu.parallel import make_scanned_rounds
+from pygrid_tpu.parallel.pallas_attention import flash_attention
+
+
+def time_marginal(fn, args, small=2, large=10, reps=5):
+    fns = {}
+    for n in (small, large):
+        fns[n] = make_scanned_rounds(fn, n_rounds=n)
+        out = fns[n](*args)
+        _ = float(out[1][-1])
+
+    def run(n):
+        t0 = time.perf_counter()
+        out = fns[n](*args)
+        _ = float(out[1][-1])
+        return time.perf_counter() - t0
+
+    t_s = min(run(small) for _ in range(reps))
+    t_l = min(run(large) for _ in range(reps))
+    return (t_l - t_s) / (large - small)
+
+
+def main():
+    cfg = transformer.TransformerConfig(
+        vocab=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048,
+        max_len=512,
+    )
+    Kc, Bc, L = 8, 4, 512
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    X = jax.random.randint(jax.random.PRNGKey(1), (Kc, Bc, L), 0, cfg.vocab)
+    y = jnp.roll(X, -1, axis=-1)
+    lr = jnp.float32(0.1)
+    args = (params, X, y, lr)
+
+    def ident_attn(q, k, v, causal=True):
+        return v
+
+    def xla_attn(q, k, v, causal=True):
+        from pygrid_tpu.parallel.ring_attention import attention
+        return attention(q, k, v, causal=causal)
+
+    variants = {
+        "flash (flagship)": transformer.make_training_step(
+            cfg, attn_fn=flash_attention, compute_dtype="bfloat16"),
+        "xla attention": transformer.make_training_step(
+            cfg, attn_fn=xla_attn, compute_dtype="bfloat16"),
+        "identity attention": transformer.make_training_step(
+            cfg, attn_fn=ident_attn, compute_dtype="bfloat16"),
+    }
+    for name, step in variants.items():
+        per = time_marginal(step, args)
+        print(f"{name:24s}: {per*1e3:8.2f} ms/round", file=sys.stderr)
+
+    # small vocab isolates the logits/log_softmax plane
+    cfg_sv = cfg._replace(vocab=512)
+    params_sv = transformer.init(jax.random.PRNGKey(0), cfg_sv)
+    X_sv = jnp.clip(X, 0, 511)
+    y_sv = jnp.roll(X_sv, -1, axis=-1)
+    step_sv = transformer.make_training_step(
+        cfg_sv, attn_fn=flash_attention, compute_dtype="bfloat16")
+    per = time_marginal(step_sv, (params_sv, X_sv, y_sv, lr))
+    print(f"{'flash vocab=512':24s}: {per*1e3:8.2f} ms/round", file=sys.stderr)
+
+    # 4 heads => head_dim 128, no pad waste in the kernel
+    cfg_h4 = cfg._replace(n_heads=4)
+    step_h4 = transformer.make_training_step(
+        cfg_h4, attn_fn=flash_attention, compute_dtype="bfloat16")
+    per = time_marginal(step_h4, args)
+    print(f"{'flash heads=4 (dh=128)':24s}: {per*1e3:8.2f} ms/round", file=sys.stderr)
+
+    # fwd-only (loss, no grad): how much is backward?
+    def fwd_only(X, y, lr, *params):
+        loss, acc = transformer.loss_and_acc(
+            list(params), X, y, cfg, flash_attention,
+            compute_dtype="bfloat16")
+        return (loss, acc, *params)
+
+    per = time_marginal(fwd_only, args)
+    print(f"{'fwd-only flash':24s}: {per*1e3:8.2f} ms/round", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

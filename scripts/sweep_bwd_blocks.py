@@ -1,0 +1,83 @@
+"""On-chip sweep of the flash backward block sizes (BWD_BLOCK_Q/K).
+
+Times the full grad step (fwd kernel + both bwd kernels) via scan-chain
+marginals with value fetch (the tunnel defers execution until a fetch).
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from pygrid_tpu.parallel import pallas_attention as pa
+
+B, L, H, D = 4, 4096, 8, 128
+
+
+def make_chain(n, bq=None, bk=None):
+    kw = {}
+    if bq is not None:
+        kw = {"bwd_block_q": bq, "bwd_block_k": bk}
+
+    def loss(q, k, v):
+        return jnp.sum(
+            pa.flash_attention(q, k, v, causal=True, **kw).astype(
+                jnp.float32
+            )
+        )
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def chain(q, k, v):
+        def body(carry, _):
+            qq, kk, vv = carry
+            dq, dk, dv = g(qq, kk, vv)
+            return (qq + dq * 1e-6, kk + dk * 1e-6, vv + dv * 1e-6), dq[0, 0, 0, 0]
+
+        _, outs = jax.lax.scan(body, (q, k, v), None, length=n)
+        return outs[-1]
+
+    return chain
+
+
+def marginal(q, k, v, bq=None, bk=None, small=2, large=8, reps=5):
+    fns = {n: make_chain(n, bq, bk) for n in (small, large)}
+    for f in fns.values():
+        _ = float(f(q, k, v))
+
+    def run(n):
+        t0 = time.perf_counter()
+        _ = float(fns[n](q, k, v))
+        return time.perf_counter() - t0
+
+    ts = min(run(small) for _ in range(reps))
+    tl = min(run(large) for _ in range(reps))
+    return (tl - ts) / (large - small)
+
+
+def main():
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, L, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, L, H, D), jnp.bfloat16)
+    dots = 2 * L * L * D * B * H * 0.5
+    for bq in (256, 512, 1024):
+        for bk in (256, 512, 1024):
+            try:
+                t = marginal(q, k, v, bq, bk)
+            except Exception as e:
+                print(f"bq={bq:5d} bk={bk:5d}: FAIL {type(e).__name__}",
+                      file=sys.stderr)
+                continue
+            eff = 9 * dots / t / 197e12 * 100
+            print(
+                f"bq={bq:5d} bk={bk:5d}: {t*1e3:7.2f} ms  eff(9dot) {eff:5.1f}%",
+                file=sys.stderr,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -105,6 +105,45 @@ def test_gradients_match_xla_reference(Lq, Lk, causal):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
 
 
+@pytest.mark.parametrize(
+    "causal,bwd_bq,bwd_bk",
+    [
+        (False, 128, 128),
+        (True, 128, 128),
+        (True, 128, 256),  # unequal blocks stress the live-bound asymmetry
+        (True, 256, 128),
+    ],
+)
+def test_gradients_multiblock(causal, bwd_bq, bwd_bk):
+    """Cross-block gradient accumulation: shrink the backward blocks so
+    the dkv kernel sweeps several q blocks into its VMEM accumulators and
+    the dq kernel sweeps several k blocks — including dead causal block
+    pairs, whose upper-triangle skip must leave the accumulators intact
+    (a sign error or an off-by-one in the `live` bound would only ever
+    surface at real sequence lengths otherwise)."""
+    q, k, v = _qkv(1, 384, 384, 2, 64, seed=7)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.tanh(fn(q, k, v)))
+
+    g_ref = jax.grad(
+        loss(lambda q, k, v: attention(q, k, v, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_flash = jax.grad(
+        loss(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, interpret=True,
+                block_q=128, block_k=128,
+                bwd_block_q=bwd_bq, bwd_block_k=bwd_bk,  # ≥2 blocks/axis
+            )
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+
+
 def test_transformer_trains_with_flash_attention():
     """A full training step (loss + grads + update) through the flash
     kernel — long-context training is the point of the O(L) backward."""
