@@ -213,6 +213,106 @@ def _reduce(fn):
     return run
 
 
+# --- gather / scatter-add ---------------------------------------------------
+#
+# Emitted by the transformer family: embedding lookup (gather rows), the
+# loss's take_along_axis (per-token logit pick), and their VJPs
+# (scatter-add into the embedding / the one-hot-like dlogits). The
+# sanitized dimension_numbers arrive as a positional list (NamedTuple
+# fields, in declaration order); batching dims default to () so older
+# traces without them still execute.
+
+
+def _gs_dnums(p) -> tuple[tuple[int, ...], ...]:
+    dims = [tuple(_dims(d)) for d in p["dimension_numbers"]]
+    if len(dims) == 3:  # pre-batching-dims trace: batching dims default ()
+        dims += [(), ()]
+    if len(dims) != 5:
+        raise PlanTranslationError(
+            f"gather/scatter: unsupported dimension_numbers arity {len(dims)}"
+        )
+    return tuple(dims)
+
+
+def _gs_mode(p) -> str:
+    """'clip' | 'fill_or_drop' | 'promise_in_bounds' from the sanitized
+    mode repr. PROMISE_IN_BOUNDS is executed as CLIP: out-of-bounds under
+    a promise is undefined behavior in XLA, and for a REMOTE-SUPPLIED
+    program clamping is the only safe refinement."""
+    mode = p.get("mode")
+    text = mode.get("__repr__", "") if isinstance(mode, dict) else str(mode)
+    if "FILL_OR_DROP" in text:
+        return "fill_or_drop"
+    if "CLIP" in text or "PROMISE_IN_BOUNDS" in text or not text:
+        return "clip"
+    raise PlanTranslationError(f"gather/scatter: unsupported mode {text!r}")
+
+
+def _gs_lax_mode(p):
+    return (
+        lax.GatherScatterMode.FILL_OR_DROP
+        if _gs_mode(p) == "fill_or_drop"
+        else lax.GatherScatterMode.CLIP
+    )
+
+
+def _gs_fill_value(fill, dtype):
+    """jax's fill_value=None resolution (lax.gather): NaN for inexact,
+    False for bool, the most negative/positive representable for
+    signed/unsigned ints — mirrored so both backends agree on the wire.
+    The inexact test goes through jnp.issubdtype: ml_dtypes types
+    (bfloat16, float8) are inexact to jax but kind-'V' voids to numpy."""
+    if fill is not None:
+        return fill
+    dt = np.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.inexact):
+        return np.nan
+    if dt == np.bool_:
+        return False
+    try:
+        info = np.iinfo(dt)
+    except ValueError as err:
+        raise PlanTranslationError(
+            f"gather: no default fill_value for dtype {dt}"
+        ) from err
+    return info.min if np.issubdtype(dt, np.signedinteger) else info.max
+
+
+def _gather(a, idx, p):
+    offs, coll, smap, ob, ib = _gs_dnums(p)
+    return lax.gather(
+        a,
+        idx,
+        lax.GatherDimensionNumbers(
+            offset_dims=offs,
+            collapsed_slice_dims=coll,
+            start_index_map=smap,
+            operand_batching_dims=ob,
+            start_indices_batching_dims=ib,
+        ),
+        slice_sizes=_dims(p["slice_sizes"]),
+        mode=_gs_lax_mode(p),
+        fill_value=p.get("fill_value"),
+    )
+
+
+def _scatter_add(a, idx, upd, p):
+    uw, ins, smap, ob, ib = _gs_dnums(p)
+    return lax.scatter_add(
+        a,
+        idx,
+        upd,
+        lax.ScatterDimensionNumbers(
+            update_window_dims=uw,
+            inserted_window_dims=ins,
+            scatter_dims_to_operand_dims=smap,
+            operand_batching_dims=ob,
+            scatter_indices_batching_dims=ib,
+        ),
+        mode=_gs_lax_mode(p),
+    )
+
+
 _INTERP_TABLE: dict[str, Any] = {
     "add": lambda a, b, p: jnp.add(a, b),
     "add_any": lambda a, b, p: jnp.add(a, b),  # autodiff accumulation
@@ -294,6 +394,8 @@ _INTERP_TABLE: dict[str, Any] = {
     "conv_general_dilated": _conv,
     "reduce_window_max": _reduce_window_max,
     "select_and_scatter_add": _select_and_scatter_add,
+    "gather": _gather,
+    "scatter-add": _scatter_add,
     "concatenate": lambda *args: lax.concatenate(
         list(args[:-1]), int(args[-1]["dimension"])
     ),
@@ -529,6 +631,166 @@ def _np_conv(a, b, p):
     return np.transpose(out, inv)
 
 
+def _np_gather(a, idx, p):
+    """Numpy twin of XLA gather (one Python loop per index row — a
+    reference interpreter, not a fast path). Handles offset/collapsed
+    dims, batching dims, CLIP and FILL_OR_DROP modes."""
+    offs, coll, smap, ob, ib = _gs_dnums(p)
+    slice_sizes = _dims(p["slice_sizes"])
+    mode = _gs_mode(p)
+    idx = np.asarray(idx)
+    a = np.asarray(a)
+    if len(slice_sizes) != a.ndim:
+        raise PlanTranslationError(
+            f"gather: slice_sizes rank {len(slice_sizes)} != operand rank "
+            f"{a.ndim}"
+        )
+    if idx.ndim < 1 or idx.shape[-1] != len(smap):
+        raise PlanTranslationError(
+            "gather: index vector dim does not match start_index_map"
+        )
+    for d, sz in enumerate(slice_sizes):
+        if not 0 <= sz <= a.shape[d]:
+            raise PlanTranslationError(
+                f"gather: slice size {sz} out of range for dim {d}"
+            )
+    for d in (*coll, *ob):
+        if slice_sizes[d] != 1:
+            raise PlanTranslationError(
+                f"gather: collapsed/batching dim {d} must have slice size 1"
+            )
+    for b in ib:
+        if not 0 <= b < idx.ndim - 1:
+            raise PlanTranslationError(
+                f"gather: indices batching dim {b} out of range"
+            )
+    batch_shape = idx.shape[:-1]
+    kept = [d for d in range(a.ndim) if d not in coll and d not in ob]
+    if len(offs) != len(kept):
+        raise PlanTranslationError("gather: offset_dims / slice-dim mismatch")
+    out_rank = len(batch_shape) + len(offs)
+    if any(not 0 <= d < out_rank for d in offs):
+        raise PlanTranslationError("gather: offset_dims out of range")
+    batch_pos = [d for d in range(out_rank) if d not in offs]
+    out_shape = [0] * out_rank
+    for d, size in zip(batch_pos, batch_shape):
+        out_shape[d] = size
+    for d, opd in zip(offs, kept):
+        out_shape[d] = slice_sizes[opd]
+    _bounded_elems(out_shape, "gather (output)")
+    if mode == "fill_or_drop":
+        # resolve the fill lazily: CLIP never consults it (and the
+        # resolution can fail typed for exotic dtypes)
+        out = np.full(
+            out_shape,
+            _gs_fill_value(p.get("fill_value"), a.dtype),
+            dtype=a.dtype,
+        )
+    else:
+        out = np.zeros(out_shape, dtype=a.dtype)  # every slot overwritten
+    for pos in np.ndindex(*batch_shape):
+        starts = [0] * a.ndim
+        for j, opd in enumerate(smap):
+            starts[opd] = int(idx[pos + (j,)])
+        for opd, idim in zip(ob, ib):
+            starts[opd] = pos[idim]
+        oob = any(
+            not 0 <= s <= a.shape[d] - slice_sizes[d]
+            for d, s in enumerate(starts)
+        )
+        if oob:
+            if mode == "fill_or_drop":
+                continue  # row already holds fill_value
+            starts = [
+                min(max(s, 0), a.shape[d] - slice_sizes[d])
+                for d, s in enumerate(starts)
+            ]
+        slc = a[tuple(
+            slice(s, s + n) for s, n in zip(starts, slice_sizes)
+        )]
+        slc = np.squeeze(slc, axis=tuple(sorted((*coll, *ob))))
+        sel: list[Any] = [slice(None)] * out_rank
+        for d, i in zip(batch_pos, pos):
+            sel[d] = i
+        out[tuple(sel)] = slc
+    return out
+
+
+def _np_scatter_add(a, idx, upd, p):
+    """Numpy twin of XLA scatter-add (same loop-per-index-row posture as
+    :func:`_np_gather`); FILL_OR_DROP drops out-of-bounds updates, CLIP
+    clamps them."""
+    uw, ins, smap, ob, ib = _gs_dnums(p)
+    mode = _gs_mode(p)
+    a = np.asarray(a)
+    idx = np.asarray(idx)
+    upd = np.asarray(upd)
+    if idx.ndim < 1 or idx.shape[-1] != len(smap):
+        raise PlanTranslationError(
+            "scatter-add: index vector dim does not match "
+            "scatter_dims_to_operand_dims"
+        )
+    for b in ib:
+        if not 0 <= b < idx.ndim - 1:
+            raise PlanTranslationError(
+                f"scatter-add: indices batching dim {b} out of range"
+            )
+    batch_shape = idx.shape[:-1]
+    window_operand_dims = [
+        d for d in range(a.ndim) if d not in ins and d not in ob
+    ]
+    if len(uw) != len(window_operand_dims):
+        raise PlanTranslationError(
+            "scatter-add: update_window_dims / operand window mismatch"
+        )
+    if any(not 0 <= d < upd.ndim for d in uw):
+        raise PlanTranslationError(
+            "scatter-add: update_window_dims out of range"
+        )
+    upd_batch_dims = [d for d in range(upd.ndim) if d not in uw]
+    if tuple(upd.shape[d] for d in upd_batch_dims) != batch_shape:
+        raise PlanTranslationError(
+            "scatter-add: update batch shape does not match indices"
+        )
+    window_sizes = [1] * a.ndim
+    for ud, opd in zip(uw, window_operand_dims):
+        window_sizes[opd] = upd.shape[ud]
+    if any(
+        window_sizes[d] > a.shape[d] for d in range(a.ndim)
+    ):
+        raise PlanTranslationError(
+            "scatter-add: update window exceeds operand"
+        )
+    out = np.array(a, copy=True)
+    for pos in np.ndindex(*batch_shape):
+        starts = [0] * a.ndim
+        for j, opd in enumerate(smap):
+            starts[opd] = int(idx[pos + (j,)])
+        for opd, idim in zip(ob, ib):
+            starts[opd] = pos[idim]
+        oob = any(
+            not 0 <= s <= a.shape[d] - window_sizes[d]
+            for d, s in enumerate(starts)
+        )
+        if oob:
+            if mode == "fill_or_drop":
+                continue
+            starts = [
+                min(max(s, 0), a.shape[d] - window_sizes[d])
+                for d, s in enumerate(starts)
+            ]
+        usel: list[Any] = [slice(None)] * upd.ndim
+        for d, i in zip(upd_batch_dims, pos):
+            usel[d] = i
+        # remaining dims are uw in ascending order ↔ window_operand_dims;
+        # reshape only re-inserts the size-1 inserted/batching dims
+        window = np.reshape(upd[tuple(usel)], window_sizes)
+        out[tuple(
+            slice(s, s + n) for s, n in zip(starts, window_sizes)
+        )] += window
+    return out
+
+
 def _np_select_n(*args):
     which, cases = args[0], list(args[1:-1])
     if len(cases) == 2 and which.dtype == np.bool_:
@@ -632,6 +894,8 @@ _NUMPY_TABLE: dict[str, Any] = {
     "conv_general_dilated": _np_conv,
     "reduce_window_max": _np_reduce_window_max,
     "select_and_scatter_add": _np_select_and_scatter_add,
+    "gather": _np_gather,
+    "scatter-add": _np_scatter_add,
     "concatenate": lambda *args: np.concatenate(
         list(args[:-1]), int(args[-1]["dimension"])
     ),
@@ -686,6 +950,16 @@ _EXPANSION_OPS = (
     "conv_general_dilated",
     "concatenate",
     "reduce_window_max",
+    # gather's output (indices × slice sizes) can dwarf both operands —
+    # an embedding-style gather with a hostile index count must fail the
+    # bound before the backend allocates (the numpy path additionally
+    # re-checks at its own allocation site)
+    "gather",
+    # scatter-add's output is operand-shaped (no blowup), but the
+    # eval_shape pass is the typed-params gate: hostile dimension_numbers
+    # must fail as PlanTranslationError on BOTH backends, not as a raw
+    # IndexError/ValueError (WIRE.md §6 error contract)
+    "scatter-add",
 )
 # select_and_scatter_add is NOT in _EXPANSION_OPS: eval_shape cannot
 # trace through the jax.vjp implementation, and its output is always

@@ -261,6 +261,58 @@ def test_foreign_client_runs_list_variant_with_numpy(grid, hosted):
     client.close()
 
 
+def test_foreign_client_trains_hosted_transformer_with_numpy(grid):
+    """The flagship-family twin of the list-variant path: host a small
+    TRANSFORMER training plan, download it as the portable 'list'
+    dialect, and train a step with numpy only — embedding gather,
+    take_along_axis, and their scatter-add VJPs all ride the published
+    dialect (docs/WIRE.md §5; reference plan_manager.py:119-149 never
+    went past MLPs)."""
+    from pygrid_tpu.models import transformer
+    from pygrid_tpu.plans.translators import run_oplist
+
+    name, version = "tiny-transformer", "1.0"
+    cfg = transformer.TransformerConfig(
+        vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=1, max_len=8
+    )
+    step = transformer.make_training_step(cfg)
+    params = [np.asarray(p) for p in transformer.init(jax.random.PRNGKey(3), cfg)]
+    plan = Plan(name="training_plan", fn=step)
+    Xz = np.zeros((2, 8), np.int32)
+    plan.build(Xz, Xz, np.float32(0.1), *params)
+    mc = ModelCentricFLClient(grid.node_url("bob"))
+    response = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={"name": name, "version": version, "lr": 0.1},
+        server_config={"min_workers": 1, "max_workers": 4, "num_cycles": 2},
+    )
+    assert response.get("status") == "success"
+    mc.close()
+
+    client = FLClient(grid.node_url("bob"))
+    auth = client.authenticate(name, version)
+    wid = auth["worker_id"]
+    cyc = client.cycle_request(wid, name, version, 1.0, 1000.0, 1000.0)
+    assert cyc["status"] == "accepted"
+    got_params = client.get_model(wid, cyc["request_key"], cyc["model_id"])
+    oplist = client.get_plan(
+        wid, cyc["request_key"], cyc["plans"]["training_plan"],
+        receive_operations_as="list",
+    )
+    rng = np.random.default_rng(9)
+    X = rng.integers(0, cfg.vocab, (2, 8)).astype(np.int32)
+    y = rng.integers(0, cfg.vocab, (2, 8)).astype(np.int32)
+    args = (X, y, np.float32(0.1), *[np.asarray(p) for p in got_params])
+    out = run_oplist(oplist, *args, backend="numpy")
+    ref = step(*args)
+    for a, b in zip(ref, out):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+    client.close()
+
+
 def test_binary_wire_full_round(grid):
     """The msgpack wire twin (FLClient(wire="binary") + bf16 payloads): a
     full cycle over binary WS frames — raw diff bytes, bf16 model download

@@ -316,6 +316,34 @@ def test_oplist_outer_product_dot_bounded():
         run_oplist(evil, backend="jax")
 
 
+def test_oplist_gather_blowup_bounded():
+    """Two small operands whose gather output explodes (many index rows
+    × a full-row slice — the embedding-style escape): the derived output
+    shape is bounded abstractly before any allocation."""
+    n = 1 << 15
+    evil = _empty_oplist(
+        eqns=[
+            {"op": "iota", "params": {
+                "dtype": "float32", "shape": [2, n], "dimension": 0,
+            }, "in": [], "out": [1]},
+            {"op": "iota", "params": {
+                "dtype": "int32", "shape": [n, 1], "dimension": 0,
+            }, "in": [], "out": [2]},
+            {"op": "gather", "params": {
+                "dimension_numbers": [[1], [0], [0], [], []],
+                "slice_sizes": [1, n],
+                "mode": {"__repr__": "GatherScatterMode.CLIP"},
+                "fill_value": None,
+            }, "in": [{"var": 1}, {"var": 2}], "out": [3]},
+        ],
+        outvars=[{"var": 3}],
+    )
+    with pytest.raises(PlanTranslationError, match="allocation bound"):
+        run_oplist(evil, backend="numpy")
+    with pytest.raises(PlanTranslationError, match="allocation bound"):
+        run_oplist(evil, backend="jax")
+
+
 def test_oplist_hostile_dot_params_typed():
     evil = _empty_oplist(
         eqns=[

@@ -219,6 +219,160 @@ def test_oplist_runs_cnn_training_plan_both_backends():
             )
 
 
+def test_oplist_runs_transformer_training_plan_both_backends():
+    """The portable dialect covers the TRANSFORMER training plan — the
+    flagship family: embedding gather + its scatter-add VJP, the loss's
+    take_along_axis (batched gather with FILL_OR_DROP), layernorm
+    (rsqrt), softmax (reduce_max/exp), gelu — on the jax interpreter AND
+    on a numpy-only client. The reference's portable variant never went
+    past MLPs (plan_manager.py:119-149); this proves a foreign client
+    can train the framework's flagship model from the published dialect."""
+    import jax
+
+    from pygrid_tpu.models import transformer
+    from pygrid_tpu.plans.plan import Plan
+
+    cfg = transformer.TransformerConfig(
+        vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=2, max_len=16
+    )
+    step = transformer.make_training_step(cfg)
+    params = [np.asarray(p) for p in transformer.init(jax.random.PRNGKey(0), cfg)]
+    rng = np.random.RandomState(11)
+    X = rng.randint(0, cfg.vocab, (2, 16)).astype(np.int32)
+    y = rng.randint(0, cfg.vocab, (2, 16)).astype(np.int32)
+    plan = Plan(name="training_plan", fn=step)
+    plan.build(X, y, np.float32(0.1), *params)
+    ref = step(X, y, np.float32(0.1), *params)
+    oplist = serde.deserialize(serde.serialize(plan.oplist))
+    for backend in ("jax", "numpy"):
+        out = run_oplist(
+            oplist, X, y, np.float32(0.1), *params, backend=backend
+        )
+        for a, b in zip(ref, out):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+
+def test_numpy_gather_scatter_match_lax():
+    """Direct parity of the numpy gather/scatter-add executors vs lax on
+    shapes beyond what the transformer plan emits: 2-d slices from a 3-d
+    operand, CLIP clamping of hostile indices, FILL_OR_DROP dropping
+    out-of-bounds updates."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pygrid_tpu.plans.translators import _INTERP_TABLE, _NUMPY_TABLE
+
+    rng = np.random.RandomState(5)
+    a = rng.randn(5, 4, 3).astype(np.float32)
+
+    def both(op, *invals, params):
+        ref = np.asarray(_INTERP_TABLE[op](*map(jnp.asarray, invals), params))
+        got = _NUMPY_TABLE[op](*invals, params)
+        assert np.asarray(got).dtype == ref.dtype
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), np.asarray(ref, np.float64),
+            rtol=1e-6, equal_nan=True,
+        )
+
+    # rows-of-planes gather, one index out of bounds -> CLIP clamps
+    idx = np.array([[0], [4], [9]], np.int32)
+    both(
+        "gather", a, idx,
+        params={
+            "dimension_numbers": [[1, 2], [0], [0], [], []],
+            "slice_sizes": [1, 4, 3],
+            "mode": {"__repr__": "GatherScatterMode.CLIP"},
+            "fill_value": None,
+        },
+    )
+    # same gather under FILL_OR_DROP -> the OOB row becomes fill_value
+    both(
+        "gather", a, idx,
+        params={
+            "dimension_numbers": [[1, 2], [0], [0], [], []],
+            "slice_sizes": [1, 4, 3],
+            "mode": {"__repr__": "GatherScatterMode.FILL_OR_DROP"},
+            "fill_value": -7.0,
+        },
+    )
+    # fill_value=None must resolve identically on both backends (jax
+    # fills NaN for floats / extremes for ints — the numpy reference
+    # interpreter is what foreign clients validate against)
+    both(
+        "gather", a, idx,
+        params={
+            "dimension_numbers": [[1, 2], [0], [0], [], []],
+            "slice_sizes": [1, 4, 3],
+            "mode": {"__repr__": "GatherScatterMode.FILL_OR_DROP"},
+            "fill_value": None,
+        },
+    )
+    both(
+        "gather", a.astype(np.int32), idx,
+        params={
+            "dimension_numbers": [[1, 2], [0], [0], [], []],
+            "slice_sizes": [1, 4, 3],
+            "mode": {"__repr__": "GatherScatterMode.FILL_OR_DROP"},
+            "fill_value": None,
+        },
+    )
+    # bfloat16 operand (a supported wire dtype): numpy sees kind-'V',
+    # jax sees inexact — both backends must still agree, incl. NaN fill
+    import ml_dtypes
+
+    for mode in ("CLIP", "FILL_OR_DROP"):
+        both(
+            "gather", a.astype(ml_dtypes.bfloat16), idx,
+            params={
+                "dimension_numbers": [[1, 2], [0], [0], [], []],
+                "slice_sizes": [1, 4, 3],
+                "mode": {"__repr__": f"GatherScatterMode.{mode}"},
+                "fill_value": None,
+            },
+        )
+    # scatter-add with an OOB row: FILL_OR_DROP must drop it
+    upd = rng.randn(3, 4, 3).astype(np.float32)
+    both(
+        "scatter-add", a, idx, upd,
+        params={
+            "dimension_numbers": [[1, 2], [0], [0], [], []],
+            "mode": {"__repr__": "GatherScatterMode.FILL_OR_DROP"},
+        },
+    )
+
+
+def test_hostile_scatter_params_typed_error():
+    """Malformed remote-supplied scatter dimension numbers must fail as
+    PlanTranslationError on both backends (WIRE.md §6), never as a raw
+    IndexError escaping the interpreter."""
+    from pygrid_tpu.utils.exceptions import PlanTranslationError
+
+    a = np.zeros((3, 4), np.float32)
+    idx = np.zeros((2, 1), np.int32)
+    upd = np.zeros((2, 4), np.float32)
+    evil = {
+        "constvars": [], "consts": [], "invars": [0, 1, 2],
+        "eqns": [{
+            "op": "scatter-add",
+            "in": [{"var": 0}, {"var": 1}, {"var": 2}],
+            "out": [3],
+            "params": {
+                # scatter dim 7 does not exist on a rank-2 operand
+                "dimension_numbers": [[1], [0], [7], [], []],
+                "mode": {"__repr__": "GatherScatterMode.CLIP"},
+            },
+        }],
+        "outvars": [{"var": 3}],
+    }
+    for backend in ("jax", "numpy"):
+        with pytest.raises(
+            PlanTranslationError, match="invalid params|allocation bound"
+        ):
+            run_oplist(evil, a, idx, upd, backend=backend)
+
+
 def test_numpy_windowed_ops_match_lax():
     """Direct parity of the three windowed numpy ops vs lax on shapes the
     plan corpus doesn't hit (odd strides, asymmetric padding, window
