@@ -350,4 +350,116 @@ class AWSServerless(Provider):
                 }
             },
         }
+        if self._wants_postgres():
+            self._postgresize(doc, name)
         return {"main.tf.json": self._json(doc)}
+
+    def _wants_postgres(self) -> bool:
+        db = self.config.db
+        return db.engine in ("postgres", "postgresql") or db.url.startswith(
+            ("postgres://", "postgresql://")
+        )
+
+    def _postgresize(self, doc: dict, name: str) -> None:
+        """Swap the EFS-sqlite grid database for a client-server
+        postgres one — the reference's Aurora-serverless posture
+        (``deploy/serverless-node/database.tf:1-6``). With an external
+        DB the Lambda concurrency pin disappears: horizontal scale was
+        the whole point of the serverless mode, and SQLite-on-EFS was
+        what forced ``reserved_concurrent_executions = 1``. An explicit
+        ``postgres://`` db.url is used as-is (bring-your-own database);
+        otherwise the stack provisions an in-VPC RDS postgres instance
+        and assembles the URL from it (password via the sensitive
+        ``db_password`` variable)."""
+        res = doc["resource"]
+        fn = res["aws_lambda_function"]["grid_app"]
+        del fn["reserved_concurrent_executions"]
+        del fn["file_system_config"]
+        fn["depends_on"] = []
+        for efs_res in (
+            "aws_efs_file_system", "aws_efs_mount_target",
+            "aws_efs_access_point",
+        ):
+            res.pop(efs_res, None)
+        # least privilege: the EFS client policy grant dies with EFS
+        res["aws_iam_role_policy_attachment"].pop("grid_lambda_efs", None)
+        db = self.config.db
+        if db.url.startswith(("postgres://", "postgresql://")):
+            # bring-your-own database: the VPC attachment existed only
+            # to reach EFS/RDS — a VPC Lambda in the default VPC has no
+            # internet egress, so an EXTERNAL database requires dropping
+            # it (an in-VPC BYO database should use db.engine=postgres
+            # with no URL and let the stack provision RDS instead)
+            fn.pop("vpc_config", None)
+            res["aws_security_group"].pop("grid_efs", None)
+            # out of the VPC, the role only needs log delivery (the VPC
+            # policy was a superset that also granted ENI management)
+            res["aws_iam_role_policy_attachment"]["grid_lambda_vpc"][
+                "policy_arn"
+            ] = (
+                "arn:aws:iam::aws:policy/service-role/"
+                "AWSLambdaBasicExecutionRole"
+            )
+            fn["environment"]["variables"]["DATABASE_URL"] = db.url
+            return
+        user = db.username or "pygrid"
+        # the Lambda keeps its VPC attachment (now to reach RDS); the
+        # EFS security group becomes the app SG — no ingress (the NFS
+        # rule dies with EFS; a Lambda SG needs egress only) — and a DB
+        # SG admits 5432 from it alone
+        res["aws_security_group"]["grid_efs"]["ingress"] = []
+        res["aws_security_group"]["grid_db"] = {
+            "name": f"{name}-db",
+            "vpc_id": "${data.aws_vpc.default.id}",
+            "ingress": [
+                {
+                    "from_port": 5432,
+                    "to_port": 5432,
+                    "protocol": "tcp",
+                    "cidr_blocks": [],
+                    "description": "postgres from the app SG",
+                    "ipv6_cidr_blocks": [],
+                    "prefix_list_ids": [],
+                    "security_groups": [
+                        "${aws_security_group.grid_efs.id}"
+                    ],
+                    "self": False,
+                }
+            ],
+            "egress": [],
+        }
+        res["aws_db_subnet_group"] = {
+            "grid_db": {
+                "name": f"{name}-db",
+                "subnet_ids": "${data.aws_subnets.default.ids}",
+            }
+        }
+        res["aws_db_instance"] = {
+            "grid_db": {
+                "identifier": f"{name}-db",
+                "engine": "postgres",
+                "instance_class": "db.t4g.micro",
+                "allocated_storage": 20,
+                "db_name": "pygrid",
+                "username": user,
+                "password": "${var.db_password}",
+                "db_subnet_group_name": (
+                    "${aws_db_subnet_group.grid_db.name}"
+                ),
+                "vpc_security_group_ids": [
+                    "${aws_security_group.grid_db.id}"
+                ],
+                "skip_final_snapshot": True,
+            }
+        }
+        doc["variable"]["db_password"] = {
+            "type": "string",
+            "sensitive": True,
+            "description": "master password for the grid postgres DB",
+        }
+        # urlencode: parse_pg_url percent-decodes the password, and RDS
+        # allows %/#/? in master passwords
+        fn["environment"]["variables"]["DATABASE_URL"] = (
+            f"postgres://{user}:${{urlencode(var.db_password)}}"
+            "@${aws_db_instance.grid_db.address}:5432/pygrid"
+        )

@@ -1,14 +1,25 @@
-"""Warehouse — a generic repository over sqlite3.
+"""Warehouse — a generic repository over a pluggable SQL engine.
 
 Parity surface: the reference's ``Warehouse(schema)`` generic ORM wrapper
 (``apps/node/src/app/main/core/warehouse.py:6-92``:
 register/query/first/last/count/contains/delete/modify/update over any
-SQLAlchemy schema). Here schemas are plain dataclasses (no SQLAlchemy in the
-image); column DDL is derived from dataclass field types, dict fields are
-stored as serde blobs (the reference's PickleType analog). ``Database`` is
-in-memory by default (the reference's test posture) or file-backed for
-durability — file databases run WAL with per-thread connections so the
-node's concurrent executor threads don't serialize through one lock.
+SQLAlchemy schema) and its any-``DATABASE_URL`` posture
+(``apps/node/src/app/__init__.py:54-59``). Here schemas are plain
+dataclasses (no SQLAlchemy in the image); column DDL is derived from
+dataclass field types, dict fields are stored as serde blobs (the
+reference's PickleType analog). Two engines sit behind one ``Database``
+facade, selected by URL scheme:
+
+- **sqlite** (default; ``:memory:``, a path, or ``sqlite://...``):
+  in-memory for the test/bench posture, or file-backed WAL with
+  per-thread connections so the node's concurrent executor threads
+  don't serialize through one lock.
+- **postgres** (``postgres://`` / ``postgresql://``): the client-server
+  backend horizontal deployments share — N node processes against one
+  coordination database (the reference's Aurora-serverless posture,
+  ``deploy/serverless-node/database.tf:1-6``) — spoken over the
+  dependency-free wire client in :mod:`pygrid_tpu.storage.pgwire` and
+  pooled exactly like the sqlite file connections.
 """
 
 from __future__ import annotations
@@ -34,6 +45,14 @@ _SQL_TYPES = {
     dt.datetime: "TEXT",
 }
 
+#: sqlite storage class → postgres column type
+_PG_TYPES = {
+    "INTEGER": "BIGINT",
+    "REAL": "DOUBLE PRECISION",
+    "TEXT": "TEXT",
+    "BLOB": "BYTEA",
+}
+
 
 def _column_type(py_type: Any) -> str:
     # unwrap Optional[...] / "X | None" annotations
@@ -47,6 +66,25 @@ def _column_type(py_type: Any) -> str:
         ):
             return sql
     return "BLOB"
+
+
+def _qmark_to_dollar(sql: str) -> str:
+    """Rewrite ``?`` placeholders to postgres ``$n``, skipping quoted
+    spans (a ``?`` inside a string literal — e.g. a migrated column
+    DEFAULT — must survive verbatim)."""
+    out = []
+    n = 0
+    in_str = False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+        elif ch == "?" and not in_str:
+            n += 1
+            out.append(f"${n}")
+        else:
+            out.append(ch)
+    return "".join(out)
 
 
 def _encode(value: Any, py_type: Any) -> Any:
@@ -102,15 +140,17 @@ class _Result:
 
 
 class Database:
-    """The sqlite handle shared by all warehouses.
+    """The SQL handle shared by all warehouses (engine picked by URL).
 
-    File-backed databases get **WAL + one connection per thread**: readers
+    sqlite file databases get **WAL + one connection per thread**: readers
     never block behind the writer, and concurrent report/readiness/checkpoint
     traffic from the node's executor threads doesn't serialize through one
     process-wide lock. In-memory databases (the test/bench posture) keep a
     single connection behind an RLock — WAL doesn't exist for ``:memory:``
     and sqlite shared-cache's table-level SQLITE_LOCKED errors (which ignore
     ``busy_timeout``) are strictly worse than a short lock under the GIL.
+    ``postgres://`` URLs pool :class:`pygrid_tpu.storage.pgwire.
+    PgConnection` sockets the same way file connections pool.
     """
 
     #: connections kept warm for reuse; beyond this, a released connection
@@ -119,12 +159,27 @@ class Database:
     POOL_SIZE = 8
 
     def __init__(self, url: str = ":memory:") -> None:
+        self.dialect = (
+            "postgres"
+            if url.startswith(("postgres://", "postgresql://"))
+            else "sqlite"
+        )
+        self._pool: list = []
+        self._pool_lock = threading.Lock()
+        if self.dialect == "postgres":
+            from pygrid_tpu.storage.pgwire import parse_pg_url
+
+            self._pg_kwargs = parse_pg_url(url)
+            self._conn = None
+            self._lock = None
+            self._is_memory = False
+            with self._connection() as _:
+                pass  # probe: fail fast on unreachable/unauthorized server
+            return
         if url.startswith("sqlite://"):
             url = url[len("sqlite://") :].lstrip("/") or ":memory:"
         self._url = url
         self._is_memory = url == ":memory:"
-        self._pool: list[sqlite3.Connection] = []
-        self._pool_lock = threading.Lock()
         if self._is_memory:
             self._conn = sqlite3.connect(url, check_same_thread=False)
             self._conn.row_factory = sqlite3.Row
@@ -135,7 +190,14 @@ class Database:
             with self._connection() as _:
                 pass  # probe: fail fast on an unopenable path
 
-    def _new_connection(self) -> sqlite3.Connection:
+    def _new_connection(self):
+        if self.dialect == "postgres":
+            from pygrid_tpu.storage.pgwire import PgConnection
+
+            return PgConnection(**self._pg_kwargs)
+        return self._new_sqlite_connection()
+
+    def _new_sqlite_connection(self) -> sqlite3.Connection:
         # check_same_thread=False: the pool hands each connection to exactly
         # one thread at a time (sqlite objects are fine serially cross-thread)
         conn = sqlite3.connect(self._url, timeout=30.0, check_same_thread=False)
@@ -146,7 +208,7 @@ class Database:
         return conn
 
     @contextlib.contextmanager
-    def _connection(self) -> Iterator[sqlite3.Connection]:
+    def _connection(self) -> Iterator[Any]:
         with self._pool_lock:
             conn = self._pool.pop() if self._pool else None
         if conn is None:
@@ -155,9 +217,12 @@ class Database:
             yield conn
         except BaseException:
             # never re-pool a connection mid-transaction: the next borrower
-            # would silently commit (or read inside) the failed statement
+            # would silently commit (or read inside) the failed statement.
+            # (pg: a PgError leaves the session at ReadyForQuery, but a
+            # socket-level failure leaves it unusable — drop either way)
             try:
-                conn.rollback()
+                if self.dialect == "sqlite":
+                    conn.rollback()
             finally:
                 conn.close()
             raise
@@ -169,6 +234,41 @@ class Database:
             conn.close()
 
     def execute(self, sql: str, params: tuple = ()) -> "_Result":
+        if self.dialect == "postgres":
+            from pygrid_tpu.storage.pgwire import PgConnectionLost
+
+            # a pooled socket may have died idle (server timeout,
+            # failover, process freeze): retry connection-level failures
+            # ONCE on a fresh connection; a fresh connection failing is
+            # a real outage and propagates
+            for attempt in (0, 1):
+                with self._pool_lock:
+                    conn = self._pool.pop() if self._pool else None
+                pooled = conn is not None
+                if conn is None:
+                    conn = self._new_connection()
+                try:
+                    rows, _ = conn.execute(_qmark_to_dollar(sql), params)
+                except PgConnectionLost:
+                    conn.close()
+                    if not pooled or attempt:
+                        raise
+                    continue
+                except BaseException:
+                    conn.close()
+                    raise
+                with self._pool_lock:
+                    keep = len(self._pool) < self.POOL_SIZE
+                    if keep:
+                        self._pool.append(conn)
+                if not keep:
+                    conn.close()
+                # postgres has no lastrowid; Warehouse.register appends
+                # RETURNING id and reads it off the first row
+                lastrowid = None
+                if rows and sql.rstrip().upper().endswith("RETURNING ID"):
+                    lastrowid = rows[0][0]
+                return _Result(rows, lastrowid)
         # SELECTs never open a write transaction (autocommit mode), so the
         # commit would be a no-op round trip — skipped; the protocol hot
         # paths run several point reads per message
@@ -220,42 +320,77 @@ class Warehouse:
         self.migrated_columns: set[str] = set()
         self._create_table()
 
+    def _coltype(self, py_type: Any) -> str:
+        base = _column_type(py_type)
+        if self.db.dialect == "postgres":
+            return _PG_TYPES[base]
+        return base
+
     def _create_table(self) -> None:
+        pg = self.db.dialect == "postgres"
         cols = []
         for f in self.fields:
-            col = f'"{f.name}" {_column_type(f.type)}'
+            col = f'"{f.name}" {self._coltype(f.type)}'
             if f.name == "id":
                 if _column_type(f.type) == "INTEGER":
-                    col = "id INTEGER PRIMARY KEY AUTOINCREMENT"
+                    col = (
+                        "id BIGSERIAL PRIMARY KEY"
+                        if pg
+                        else "id INTEGER PRIMARY KEY AUTOINCREMENT"
+                    )
                 else:
                     col = "id TEXT PRIMARY KEY"
             cols.append(col)
+        if pg:
+            # insertion-order column standing in for sqlite's implicit
+            # rowid — last() orders by it
+            cols.append('"_seq" BIGSERIAL')
         self.db.execute(
             f"CREATE TABLE IF NOT EXISTS {self.table} ({', '.join(cols)})"
         )
         self._migrate_missing_columns()
 
-    def _migrate_missing_columns(self) -> None:
-        """Schema evolution for file-backed DBs: a dataclass can grow
-        fields across releases, but register() always INSERTs every field
-        — without ALTER TABLE, a node restarted on an old DB would fail
-        its first write. Scalar dataclass defaults are emitted as column
-        DEFAULTs so sqlite backfills PRE-migration rows with them; fields
-        defaulting to None (or with non-scalar defaults) read back None
-        for old rows."""
-        existing = {
+    @property
+    def _order_rowid(self) -> str:
+        return '"_seq"' if self.db.dialect == "postgres" else "rowid"
+
+    def _existing_columns(self) -> set[str]:
+        if self.db.dialect == "postgres":
+            # current_schema() filter: a same-named table in another
+            # schema of a shared database must not make a column look
+            # "existing" and suppress the migration
+            return {
+                row[0]
+                for row in self.db.execute(
+                    "SELECT column_name FROM information_schema.columns "
+                    "WHERE table_name = ? "
+                    "AND table_schema = current_schema()",
+                    (self.table.strip('"'),),
+                ).fetchall()
+            }
+        return {
             row[1]
             for row in self.db.execute(
                 f"PRAGMA table_info({self.table})"
             ).fetchall()
         }
+
+    def _migrate_missing_columns(self) -> None:
+        """Schema evolution for durable DBs: a dataclass can grow
+        fields across releases, but register() always INSERTs every field
+        — without ALTER TABLE, a node restarted on an old DB would fail
+        its first write. Scalar dataclass defaults are emitted as column
+        DEFAULTs so the engine backfills PRE-migration rows with them;
+        fields defaulting to None (or with non-scalar defaults) read back
+        None for old rows."""
+        existing = self._existing_columns()
         for f in self.fields:
             if f.name in existing:
                 continue
             self.migrated_columns.add(f.name)
             ddl = (
                 f"ALTER TABLE {self.table} ADD COLUMN "
-                f'"{f.name}" {_column_type(f.type)}'
+                f'"{f.name}" {self._coltype(f.type)}'
             )
             default = getattr(f, "default", None)
             if isinstance(default, bool):
@@ -282,8 +417,11 @@ class Warehouse:
             f"INSERT INTO {self.table} ({', '.join(names)}) "
             f"VALUES ({', '.join('?' * len(names))})"
         )
+        needs_id = getattr(obj, "id", None) is None
+        if needs_id and self.db.dialect == "postgres":
+            sql += " RETURNING id"
         cur = self.db.execute(sql, tuple(values))
-        if getattr(obj, "id", None) is None:
+        if needs_id:
             object.__setattr__(obj, "id", cur.lastrowid)
         return obj
 
@@ -370,7 +508,7 @@ class Warehouse:
         where, params = self._where(filters)
         cur = self.db.execute(
             f"SELECT {self._select(columns)} FROM {self.table}{where} "
-            f"ORDER BY rowid DESC LIMIT 1",
+            f"ORDER BY {self._order_rowid} DESC LIMIT 1",
             params,
         )
         row = cur.fetchone()

@@ -8,6 +8,8 @@ import datetime as dt
 import jax
 import jax.numpy as jnp
 import numpy as np
+import os
+
 import pytest
 
 from pygrid_tpu.federated import FLController, auth as fed_auth, tasks
@@ -54,6 +56,45 @@ def _training_plan():
     return plan
 
 
+
+#: engines the suite runs against: sqlite always; postgres against a
+#: live server when PYGRID_TEST_DATABASE_URL names a throwaway database,
+#: else against the in-process protocol-v3 fake (tests/unit/_pg_fake.py)
+#: so the pg engine path executes in CI regardless. Every fresh_db()
+#: call drops the grid tables first so each test starts clean,
+#: mirroring :memory: semantics.
+_GRID_TABLES = (
+    "flprocess", "model", "modelcheckpoint", "plan", "protocol", "config",
+    "cycle", "workercycle", "worker", "serveroptstate",
+    "fedbuffcontribution",
+)
+
+
+@pytest.fixture(params=["sqlite", "postgres"])
+def fresh_db(request):
+    """Factory for a clean Database on the parametrized engine."""
+    if request.param == "postgres":
+        url = os.environ.get("PYGRID_TEST_DATABASE_URL")
+        fake = None
+        if not url:
+            from _pg_fake import FakePg
+
+            fake = FakePg()
+            url = fake.url
+
+        def make():
+            db = Database(url)
+            for t in _GRID_TABLES:
+                db.execute(f'DROP TABLE IF EXISTS "{t}"')
+            return db
+
+        yield make
+        if fake is not None:
+            fake.close()
+        return
+    yield lambda: Database(":memory:")
+
+
 SERVER_CONFIG = {
     "min_workers": 2,
     "max_workers": 5,
@@ -71,8 +112,8 @@ CLIENT_CONFIG = {
 
 
 @pytest.fixture()
-def controller():
-    db = Database(":memory:")
+def controller(fresh_db):
+    db = fresh_db()
     ctl = FLController(db)
     ctl.create_process(
         model_blob=serialize_model_params(_model_params()),
@@ -222,10 +263,10 @@ def test_checkpoint_history_retrievable(controller):
     assert first.number == 1 and latest.number == 2
 
 
-def test_iterative_averaging_plan():
+def test_iterative_averaging_plan(fresh_db):
     """Hosted running-mean averaging plan: avg = plan(*avg, *diff, i) with the
     index LAST (reference cycle_manager.py:269)."""
-    db = Database(":memory:")
+    db = fresh_db()
     ctl = FLController(db)
 
     def running_mean(avg_w, avg_b, diff_w, diff_b, i):
@@ -352,13 +393,13 @@ def test_auth_expired_token():
         fed_auth.verify_token(token, cfg)
 
 
-def test_aggregation_scales_to_256_diffs():
+def test_aggregation_scales_to_256_diffs(fresh_db):
     """One cycle ingesting 256 worker diffs: the submit-time accumulator
     folds each into the running f64 sum, so completion is a divide and the
     result is the exact average (the scaling case the reference's per-diff
     f32 reduce loop, cycle_manager.py:275-290, degrades on)."""
     K = 256
-    db = Database(":memory:")
+    db = fresh_db()
     ctl = FLController(db)
     params = _model_params()
     ctl.create_process(
@@ -398,14 +439,14 @@ def test_aggregation_scales_to_256_diffs():
     )
 
 
-def test_deadline_completes_cycle_without_further_reports():
+def test_deadline_completes_cycle_without_further_reports(fresh_db):
     """min_diffs reached, remaining workers vanish: the deadline timer armed
     at cycle creation closes the cycle within ~1s of ``cycle.end`` with no
     further protocol event. The reference only re-checks readiness inside
     submit_worker_diff (cycle_manager.py:180-217), so its cycle would hang."""
     import time
 
-    db = Database(":memory:")
+    db = fresh_db()
     ctl = FLController(db)
     params = _model_params()
     ctl.create_process(
@@ -420,7 +461,10 @@ def test_deadline_completes_cycle_without_further_reports():
             max_diffs=5,
             min_workers=1,
             max_workers=5,
-            cycle_length=1,  # seconds
+            # 3s, not 1s: the postgres engines add per-statement socket
+            # round-trips to setup, and the deadline must not fire
+            # before the first is-open assertion
+            cycle_length=3,
             num_cycles=1,
         ),
     )
@@ -431,7 +475,7 @@ def test_deadline_completes_cycle_without_further_reports():
     ctl.submit_diff("early-bird", resp[CYCLE.KEY], serialize_model_params(diff))
     cycle = ctl.cycle_manager._cycles.first(is_completed=False)
     assert cycle is not None, "cycle must stay open until the deadline"
-    deadline = time.monotonic() + 3.0
+    deadline = time.monotonic() + 8.0
     while time.monotonic() < deadline:
         cycle = ctl.cycle_manager._cycles.first(id=cycle.id)
         if cycle.is_completed:
@@ -444,12 +488,12 @@ def test_deadline_completes_cycle_without_further_reports():
     np.testing.assert_allclose(np.asarray(new[0]), params[0] - 0.5, rtol=1e-5)
 
 
-def test_recover_deadlines_rearms_after_restart():
+def test_recover_deadlines_rearms_after_restart(fresh_db):
     """A node restarted mid-cycle re-arms deadline timers from SQL
     (recover_deadlines is called by NodeContext init)."""
     import time
 
-    db = Database(":memory:")
+    db = fresh_db()
     ctl = FLController(db)
     params = _model_params()
     ctl.create_process(
@@ -460,7 +504,7 @@ def test_recover_deadlines_rearms_after_restart():
         client_config=dict(CLIENT_CONFIG, name="mnist-recover"),
         server_config=dict(
             SERVER_CONFIG, min_diffs=1, max_diffs=5, min_workers=1,
-            cycle_length=1, num_cycles=1,
+            cycle_length=3, num_cycles=1,
         ),
     )
     w = _register_worker(ctl, "w-restart")
@@ -472,7 +516,7 @@ def test_recover_deadlines_rearms_after_restart():
     timer = ctl.cycle_manager._deadline_timers.pop(cycle.id)
     timer.cancel()
     ctl.cycle_manager.recover_deadlines()
-    deadline = time.monotonic() + 3.0
+    deadline = time.monotonic() + 8.0
     while time.monotonic() < deadline:
         if ctl.cycle_manager._cycles.first(id=cycle.id).is_completed:
             break
@@ -480,11 +524,11 @@ def test_recover_deadlines_rearms_after_restart():
     assert ctl.cycle_manager._cycles.first(id=cycle.id).is_completed
 
 
-def test_accumulator_matches_blob_rebuild():
+def test_accumulator_matches_blob_rebuild(fresh_db):
     """The streaming accumulator and the restart path (rebuild from stored
     blobs) must agree exactly: drop the accumulator mid-cycle and the
     aggregate is unchanged."""
-    db = Database(":memory:")
+    db = fresh_db()
     ctl = FLController(db)
     params = _model_params()
     ctl.create_process(
@@ -514,13 +558,13 @@ def test_accumulator_matches_blob_rebuild():
     np.testing.assert_allclose(np.asarray(new[0]), expected, rtol=1e-5)
 
 
-def test_deadline_with_zero_diffs_closes_cycle_without_checkpoint():
+def test_deadline_with_zero_diffs_closes_cycle_without_checkpoint(fresh_db):
     """No min_diffs + nobody reports: the deadline closes the cycle with
     the model unchanged (no checkpoint written) and spawns the next cycle —
     averaging nothing must not crash the timer thread."""
     import time
 
-    db = Database(":memory:")
+    db = fresh_db()
     ctl = FLController(db)
     params = _model_params()
     ctl.create_process(
@@ -535,7 +579,7 @@ def test_deadline_with_zero_diffs_closes_cycle_without_checkpoint():
         },
     )
     first = ctl.cycle_manager._cycles.first(is_completed=False)
-    deadline = time.monotonic() + 3.0
+    deadline = time.monotonic() + 8.0
     while time.monotonic() < deadline:
         if ctl.cycle_manager._cycles.first(id=first.id).is_completed:
             break
@@ -580,11 +624,11 @@ def test_add_raw_matches_add_exactly():
         np.testing.assert_array_equal(s_dec, s_raw)
 
 
-def test_wrong_shape_fast_path_report_bounces():
+def test_wrong_shape_fast_path_report_bounces(fresh_db):
     """A dense State with mismatched shapes must bounce through the fast
     ingest exactly like the decode door (same typed error, no state
     change)."""
-    db = Database(":memory:")
+    db = fresh_db()
     ctl = FLController(db)
     params = _model_params()
     ctl.create_process(
@@ -607,22 +651,28 @@ def test_wrong_shape_fast_path_report_bounces():
     ctl.submit_diff("bad-shape-w", resp[CYCLE.KEY], serialize_model_params(good))
 
 
-def test_fedbuff_migration_marks_preexisting_rows_flushed():
+def test_fedbuff_migration_marks_preexisting_rows_flushed(fresh_db):
     """A pre-durability DB (no `flushed` column) migrates with every
     completed row marked flushed — whatever those rows contributed was
     handled by the old in-memory flush, and they must never re-enter a
     buffer and double-apply onto the current checkpoint."""
-    db = Database(":memory:")
+    db = fresh_db()
+    # hand-written pre-upgrade DDL must speak the engine's own dialect
+    # (a live postgres rejects AUTOINCREMENT and x'..' literals)
+    if db.dialect == "postgres":
+        pk, blob = "id BIGSERIAL PRIMARY KEY", "BYTEA"
+    else:
+        pk, blob = "id INTEGER PRIMARY KEY AUTOINCREMENT", "BLOB"
     db.execute(
-        'CREATE TABLE "workercycle" ('
-        "id INTEGER PRIMARY KEY AUTOINCREMENT, cycle_id INTEGER, "
+        f'CREATE TABLE "workercycle" ({pk}, cycle_id INTEGER, '
         "worker_id TEXT, request_key TEXT, started_at TEXT, "
-        "is_completed INTEGER, completed_at TEXT, diff BLOB, "
-        "assigned_checkpoint INTEGER, metrics BLOB)"
+        f"is_completed INTEGER, completed_at TEXT, diff {blob}, "
+        f"assigned_checkpoint INTEGER, metrics {blob})"
     )
     db.execute(
         'INSERT INTO "workercycle" (cycle_id, worker_id, request_key, '
-        "is_completed, diff) VALUES (1, 'old-w', 'old-k', 1, x'00')"
+        "is_completed, diff) VALUES (1, 'old-w', 'old-k', 1, ?)",
+        (b"\x00",),
     )
     db.execute(
         'INSERT INTO "workercycle" (cycle_id, worker_id, request_key, '

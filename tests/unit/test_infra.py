@@ -158,3 +158,61 @@ def test_aws_serverless_renders_lambda_with_efs(tmp_path):
     assert fn["file_system_config"]["local_mount_path"] == "/mnt/pygrid"
     assert "aws_lambda_function_url" in doc["resource"]
     assert "aws_efs_file_system" in doc["resource"]
+    # sqlite-on-EFS cannot take concurrent writers: the pin must stay
+    assert fn["reserved_concurrent_executions"] == 1
+
+
+def test_aws_serverless_postgres_lifts_concurrency_pin(tmp_path):
+    """With a client-server DB the Lambda scales horizontally: the stack
+    provisions in-VPC RDS postgres, drops EFS, and removes the
+    reserved-concurrency pin (the reference's Aurora posture,
+    deploy/serverless-node/database.tf:1-6)."""
+    import json as _json
+
+    from pygrid_tpu.infra.config import DbConfig
+
+    cfg = _node_config(
+        tmp_path, provider="aws", deployment_type="serverless",
+        db=DbConfig(engine="postgres"),
+    )
+    files = build_provider(cfg).render()
+    doc = _json.loads(files["main.tf.json"])
+    fn = doc["resource"]["aws_lambda_function"]["grid_app"]
+    assert "reserved_concurrent_executions" not in fn
+    assert "file_system_config" not in fn
+    assert "aws_efs_file_system" not in doc["resource"]
+    rds = doc["resource"]["aws_db_instance"]["grid_db"]
+    assert rds["engine"] == "postgres"
+    assert doc["variable"]["db_password"]["sensitive"] is True
+    url = fn["environment"]["variables"]["DATABASE_URL"]
+    assert url.startswith("postgres://") and "grid_db.address" in url
+    assert "urlencode(var.db_password)" in url
+    # least privilege: the EFS policy grant and NFS ingress die with EFS
+    assert "grid_lambda_efs" not in doc["resource"][
+        "aws_iam_role_policy_attachment"
+    ]
+    assert doc["resource"]["aws_security_group"]["grid_efs"]["ingress"] == []
+
+
+def test_aws_serverless_byo_postgres_url(tmp_path):
+    """An explicit postgres:// db.url is wired through verbatim — no RDS
+    is provisioned (bring-your-own database)."""
+    import json as _json
+
+    from pygrid_tpu.infra.config import DbConfig
+
+    cfg = _node_config(
+        tmp_path, provider="aws", deployment_type="serverless",
+        db=DbConfig(engine="postgres", url="postgres://u:p@db.corp:5432/grid"),
+    )
+    files = build_provider(cfg).render()
+    doc = _json.loads(files["main.tf.json"])
+    fn = doc["resource"]["aws_lambda_function"]["grid_app"]
+    assert "reserved_concurrent_executions" not in fn
+    assert "aws_db_instance" not in doc["resource"]
+    env = fn["environment"]["variables"]
+    assert env["DATABASE_URL"] == "postgres://u:p@db.corp:5432/grid"
+    # an external DB is unreachable from a default-VPC Lambda: the BYO
+    # branch must drop the VPC attachment (and the now-unused app SG)
+    assert "vpc_config" not in fn
+    assert "grid_efs" not in doc["resource"]["aws_security_group"]

@@ -1,16 +1,51 @@
-"""Warehouse/sqlite repository — mirrors reference apps/node/tests/database/
-(insert/query/modify/delete per schema, in-memory DB per test)."""
+"""Warehouse repository over both engines — mirrors reference
+apps/node/tests/database/ (insert/query/modify/delete per schema,
+in-memory DB per test). The postgres parametrization runs the same
+suite against a live server when ``PYGRID_TEST_DATABASE_URL`` is set
+(a dedicated throwaway database — tables are dropped per test) and
+skips otherwise; the wire client itself is covered unconditionally by
+tests/unit/test_pgwire.py's scripted server."""
 
 import datetime as dt
+import os
 
 import pytest
 
 from pygrid_tpu.federated import schemas as S
 from pygrid_tpu.storage import Database, Warehouse
 
+_PG_TEST_TABLES = (
+    "flprocess", "worker", "config", "workercycle", "cycle", "thing",
+)
 
-@pytest.fixture()
-def db():
+
+@pytest.fixture(params=["sqlite", "postgres"])
+def db(request):
+    if request.param == "postgres":
+        url = os.environ.get("PYGRID_TEST_DATABASE_URL")
+        fake = None
+        if not url:
+            # no live server in this image: the suite still RUNS the
+            # postgres engine — wire client, $n rewrite, RETURNING,
+            # blob/NULL encoding — against the in-process protocol-v3
+            # fake (tests/unit/_pg_fake.py)
+            from _pg_fake import FakePg
+
+            fake = FakePg()
+            url = fake.url
+        try:
+            d = Database(url)
+        except Exception as err:  # pragma: no cover - env-dependent
+            pytest.skip(f"postgres unreachable: {err}")
+        for t in _PG_TEST_TABLES:
+            d.execute(f'DROP TABLE IF EXISTS "{t}"')
+        yield d
+        for t in _PG_TEST_TABLES:
+            d.execute(f'DROP TABLE IF EXISTS "{t}"')
+        d.close()
+        if fake is not None:
+            fake.close()
+        return
     d = Database(":memory:")
     yield d
     d.close()
