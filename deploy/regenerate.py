@@ -60,6 +60,19 @@ STACKS: dict[str, dict] = {
         "app": {"name": "node", "id": "alice", "port": 5000,
                 "network": "http://network.example.com:7000"},
     },
+    # the reference's CLI listed azure but only ever shipped a stub class
+    # (api/providers/azure/azure.py:1-10) — these are working twins
+    "azure-serverfull-node": {
+        "provider": "azure",
+        "deployment_type": "serverfull",
+        "app": {"name": "node", "id": "alice", "port": 5000,
+                "network": "http://network.example.com:7000"},
+    },
+    "azure-serverless-node": {
+        "provider": "azure",
+        "deployment_type": "serverless",
+        "app": {"name": "node", "id": "alice", "port": 5000},
+    },
 }
 
 

@@ -2,14 +2,16 @@
 
 Parity: the dispatch table in reference ``api/__main__.py:22-35``
 (provider × deployment_type → builder class; azure/gcp were empty stubs
-there — here GCP is the first-class TPU target, AWS renders runnable
-stacks for the coordination plane, and azure raises clearly)."""
+there — here GCP is the first-class TPU target, and AWS *and* Azure
+render runnable stacks for the coordination plane, closing the last
+cloud-target asymmetry with the reference's CLI surface)."""
 
 from __future__ import annotations
 
 from pygrid_tpu.infra.config import DeployConfig
 from pygrid_tpu.infra.providers.base import Provider, server_command
 from pygrid_tpu.infra.providers.aws import AWSServerfull, AWSServerless
+from pygrid_tpu.infra.providers.azure import AzureServerfull, AzureServerless
 from pygrid_tpu.infra.providers.gcp import GCPServerfull, GCPServerless
 from pygrid_tpu.infra.providers.local import LocalProvider
 
@@ -18,6 +20,8 @@ __all__ = ["build_provider", "Provider", "server_command"]
 _REGISTRY = {
     ("aws", "serverfull"): AWSServerfull,
     ("aws", "serverless"): AWSServerless,
+    ("azure", "serverfull"): AzureServerfull,
+    ("azure", "serverless"): AzureServerless,
     ("gcp", "serverfull"): GCPServerfull,
     ("gcp", "serverless"): GCPServerless,
     ("local", "serverfull"): LocalProvider,

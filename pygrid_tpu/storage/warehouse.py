@@ -177,7 +177,14 @@ class Database:
                 pass  # probe: fail fast on unreachable/unauthorized server
             return
         if url.startswith("sqlite://"):
-            url = url[len("sqlite://") :].lstrip("/") or ":memory:"
+            # SQLAlchemy path semantics: sqlite:///rel.db is relative,
+            # sqlite:////abs/path.db is absolute — strip the scheme and
+            # exactly ONE path slash (lstripping all of them silently
+            # turned every absolute path relative to the server's cwd)
+            rest = url[len("sqlite://") :]
+            if rest.startswith("/"):
+                rest = rest[1:]
+            url = rest or ":memory:"
         self._url = url
         self._is_memory = url == ":memory:"
         if self._is_memory:
@@ -233,17 +240,47 @@ class Database:
         if not keep:
             conn.close()
 
+    #: pooled pg connections idle longer than this are closed on
+    #: checkout instead of reused — the server (or an RDS failover) may
+    #: have dropped them, and a write on a dead socket cannot be safely
+    #: retried (the statement may have committed before the reply died)
+    PG_RECYCLE_S = 60.0
+
+    def _pg_checkout(self):
+        """A pooled-and-fresh-enough connection, or None."""
+        import time
+
+        stale = []
+        conn = None
+        with self._pool_lock:
+            while self._pool:
+                cand = self._pool.pop()
+                age = time.monotonic() - getattr(cand, "_pooled_at", 0.0)
+                if age <= self.PG_RECYCLE_S:
+                    conn = cand
+                    break
+                stale.append(cand)
+        for c in stale:
+            c.close()
+        return conn
+
     def execute(self, sql: str, params: tuple = ()) -> "_Result":
         if self.dialect == "postgres":
+            import time
+
             from pygrid_tpu.storage.pgwire import PgConnectionLost
 
-            # a pooled socket may have died idle (server timeout,
-            # failover, process freeze): retry connection-level failures
-            # ONCE on a fresh connection; a fresh connection failing is
-            # a real outage and propagates
+            # Retry policy: a pooled socket can die idle (server
+            # timeout, failover). Reads are idempotent — retried once on
+            # a FRESH connection (never another pool pop: after a
+            # failover every pooled socket is dead). Writes are not
+            # retried at all: TCP cannot distinguish "died before the
+            # server saw it" from "committed but the reply was lost",
+            # and a double-applied INSERT is worse than a typed error.
+            # The idle-recycle in _pg_checkout keeps that case rare.
+            is_read = sql.lstrip()[:6].upper() == "SELECT"
             for attempt in (0, 1):
-                with self._pool_lock:
-                    conn = self._pool.pop() if self._pool else None
+                conn = self._pg_checkout() if attempt == 0 else None
                 pooled = conn is not None
                 if conn is None:
                     conn = self._new_connection()
@@ -251,12 +288,13 @@ class Database:
                     rows, _ = conn.execute(_qmark_to_dollar(sql), params)
                 except PgConnectionLost:
                     conn.close()
-                    if not pooled or attempt:
-                        raise
-                    continue
+                    if pooled and is_read and attempt == 0:
+                        continue
+                    raise
                 except BaseException:
                     conn.close()
                     raise
+                conn._pooled_at = time.monotonic()
                 with self._pool_lock:
                     keep = len(self._pool) < self.POOL_SIZE
                     if keep:

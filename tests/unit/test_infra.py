@@ -71,8 +71,10 @@ def test_local_provider_dry_run(tmp_path):
 def test_unknown_provider_rejected(tmp_path):
     with pytest.raises(ValueError):
         DeployConfig(provider="ibm")
-    with pytest.raises(NotImplementedError):
-        build_provider(_node_config(tmp_path, provider="azure"))
+    # azure graduated from the reference's stub to a working provider
+    assert build_provider(
+        _node_config(tmp_path, provider="azure")
+    ).name == "azure-serverfull"
 
 
 def test_handle_deploy_roundtrip(tmp_path):
@@ -96,6 +98,43 @@ def test_cli_direct_dry_run(tmp_path, capsys):
     configs = list((tmp_path / ".pygrid_tpu" / "cli").glob("config_*.json"))
     assert len(configs) == 1
     assert json.load(open(configs[0]))["app"]["name"] == "network"
+
+
+def test_azure_serverfull_renders_vm(tmp_path):
+    import json as _json
+
+    cfg = _node_config(tmp_path, provider="azure")
+    files = build_provider(cfg).render()
+    doc = _json.loads(files["main.tf.json"])
+    vm = doc["resource"]["azurerm_linux_virtual_machine"]["grid_app"]
+    assert vm["size"].startswith("Standard_")
+    nsg = doc["resource"]["azurerm_network_security_group"]["grid"]
+    assert nsg["security_rule"][0]["destination_port_range"] == str(
+        cfg.app.port
+    )
+    assert "pip install pygrid-tpu" in files["user_data.sh"]
+
+
+def test_azure_serverless_renders_container_group(tmp_path):
+    import json as _json
+
+    from pygrid_tpu.infra.config import DbConfig
+
+    cfg = _node_config(
+        tmp_path, provider="azure", deployment_type="serverless",
+        db=DbConfig(engine="postgres", url="postgres://u:p@db.corp/grid"),
+    )
+    files = build_provider(cfg).render()
+    doc = _json.loads(files["main.tf.json"])
+    grp = doc["resource"]["azurerm_container_group"]["grid_app"]
+    container = grp["container"][0]
+    assert container["image"] == "${var.image_uri}"
+    assert "pygrid_tpu.node" in " ".join(container["commands"])
+    assert (
+        container["environment_variables"]["DATABASE_URL"]
+        == "postgres://u:p@db.corp/grid"
+    )
+    assert grp["ip_address_type"] == "Public"
 
 
 def test_checked_in_stacks_match_builders():

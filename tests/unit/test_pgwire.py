@@ -6,23 +6,28 @@ execution coverage the dependency-free client gets in CI; the
 warehouse-over-postgres parametrization (test_warehouse.py) adds a live
 server when PYGRID_TEST_DATABASE_URL is set."""
 
-import base64
 import hashlib
-import hmac
 import socket
 import struct
 import threading
 
 import pytest
 
+from _pg_fake import (  # the shared scripted-server wire helpers
+    DB,
+    PASSWORD,
+    USER,
+    _col,
+    _read_msg,
+    _scram_server,
+    _send,
+)
 from pygrid_tpu.storage.pgwire import (
     PgConnection,
     PgError,
     parse_pg_url,
 )
 from pygrid_tpu.storage.warehouse import _qmark_to_dollar
-
-USER, PASSWORD, DB = "grid", "s3cret", "griddb"
 
 
 def test_parse_pg_url():
@@ -60,25 +65,7 @@ def test_qmark_to_dollar():
     assert _qmark_to_dollar("SELECT '?' , ?") == "SELECT '?' , $1"
 
 
-# --- scripted server --------------------------------------------------------
-
-
-def _read_msg(conn):
-    head = conn.recv(5)
-    while len(head) < 5:
-        chunk = conn.recv(5 - len(head))
-        assert chunk, "client closed"
-        head += chunk
-    mtype = head[:1]
-    (length,) = struct.unpack("!I", head[1:5])
-    body = b""
-    while len(body) < length - 4:
-        body += conn.recv(length - 4 - len(body))
-    return mtype, body
-
-
-def _send(conn, mtype: bytes, payload: bytes):
-    conn.sendall(mtype + struct.pack("!I", len(payload) + 4) + payload)
+# --- scripted server (wire helpers shared with _pg_fake) --------------------
 
 
 def _read_startup(conn):
@@ -101,43 +88,9 @@ def _auth_ok(conn):
     _send(conn, b"Z", b"I")
 
 
-def _auth_scram(conn):
-    """Genuine server-side SCRAM-SHA-256: verifies the client proof."""
-    _send(conn, b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\x00\x00")
-    mtype, body = _read_msg(conn)
-    assert mtype == b"p"
-    end = body.index(b"\x00")
-    assert body[:end] == b"SCRAM-SHA-256"
-    (ilen,) = struct.unpack("!I", body[end + 1 : end + 5])
-    client_first = body[end + 5 : end + 5 + ilen].decode()
-    assert client_first.startswith("n,,")
-    bare = client_first[3:]
-    client_nonce = dict(
-        kv.split("=", 1) for kv in bare.split(",")
-    )["r"]
-    salt, iters = b"pepper-salt", 4096
-    server_nonce = client_nonce + "SERVER"
-    server_first = (
-        f"r={server_nonce},s={base64.b64encode(salt).decode()},i={iters}"
-    )
-    _send(conn, b"R", struct.pack("!I", 11) + server_first.encode())
-    mtype, body = _read_msg(conn)
-    assert mtype == b"p"
-    final = body.decode()
-    fields = dict(kv.split("=", 1) for kv in final.split(","))
-    assert fields["r"] == server_nonce
-    salted = hashlib.pbkdf2_hmac("sha256", PASSWORD.encode(), salt, iters)
-    client_key = hmac.digest(salted, b"Client Key", "sha256")
-    stored_key = hashlib.sha256(client_key).digest()
-    without_proof = final[: final.rindex(",p=")]
-    auth_msg = ",".join((bare, server_first, without_proof)).encode()
-    signature = hmac.digest(stored_key, auth_msg, "sha256")
-    expect_proof = bytes(a ^ b for a, b in zip(client_key, signature))
-    assert base64.b64decode(fields["p"]) == expect_proof, "bad SCRAM proof"
-    server_key = hmac.digest(salted, b"Server Key", "sha256")
-    v = base64.b64encode(hmac.digest(server_key, auth_msg, "sha256"))
-    _send(conn, b"R", struct.pack("!I", 12) + b"v=" + v)
-    _auth_ok(conn)
+#: genuine server-side SCRAM-SHA-256 (verifies the client proof) —
+#: the shared implementation in _pg_fake
+_auth_scram = _scram_server
 
 
 def _auth_md5(conn):
