@@ -532,13 +532,17 @@ def _bench_protocol_once(wire: str) -> dict:
 
         gc.collect()
         gc.disable()
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=PROTO_DEADLINE)
-        wall = time.perf_counter() - t0
-        gc.enable()
+        try:
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=PROTO_DEADLINE)
+            wall = time.perf_counter() - t0
+        finally:
+            # an exception here (thread-start failure, Ctrl-C in join)
+            # must not leave gc off for every later bench section
+            gc.enable()
         completed = sum(1 for c in cycles_done if c >= R)
         total_updates = sum(cycles_done)
         if errors:

@@ -11,12 +11,17 @@ syft clients while avoiding per-message thread handoffs on busy hosts).
 from __future__ import annotations
 
 import json
+import re
 import threading
 import uuid
 from typing import Any
 
 from pygrid_tpu.client.ws_transport import RawWSClient
 from pygrid_tpu.utils.codes import MSG_FIELD
+
+#: bytes a JSON string cannot carry verbatim: the two escape characters,
+#: controls, and anything non-ASCII (send_json_spliced's safety gate)
+_SPLICE_UNSAFE = re.compile(rb'["\\\x00-\x1f\x7f-\xff]')
 
 
 class GridWSClient:
@@ -139,6 +144,21 @@ class GridWSClient:
         ``bytes`` value (e.g. straight from ``b64encode``) skips the
         str-decode/utf-8-encode round trip entirely. The FL report path
         sends ~1.7 MB frames per cycle through this."""
+        payload = (
+            raw_value if isinstance(raw_value, bytes) else raw_value.encode()
+        )
+        # the splice bypasses json.dumps' escaping, so the framing
+        # invariant is only as strong as this check: any byte that JSON
+        # would escape (quote, backslash, control, non-ASCII) must be
+        # rejected, not silently spliced into a meaning-altering frame —
+        # for the key as much as the value
+        if _SPLICE_UNSAFE.search(payload) or _SPLICE_UNSAFE.search(
+            raw_key.encode()
+        ):
+            raise ValueError(
+                "send_json_spliced key/value must be escape-free ASCII "
+                "(base64-alphabet); got a byte JSON would escape"
+            )
         with self._lock:
             self.connect()
             self._req_seq += 1
@@ -150,13 +170,9 @@ class GridWSClient:
                     MSG_FIELD.DATA: data,
                 }
             )
-            assert head.endswith("}}")
+            if not head.endswith("}}"):
+                raise ValueError("unexpected JSON head shape for splice")
             sep = ", " if data else ""
-            payload = (
-                raw_value
-                if isinstance(raw_value, bytes)
-                else raw_value.encode()
-            )
             frame = b"".join(
                 (head[:-2].encode(), f'{sep}"{raw_key}": "'.encode(),
                  payload, b'"}}')

@@ -24,6 +24,7 @@ import random
 import socket
 import ssl as ssl_module
 import struct
+import time
 from urllib.parse import urlparse
 
 from pygrid_tpu.native import xor_mask_inplace
@@ -133,6 +134,7 @@ class RawWSClient:
             ctx = ssl_module.create_default_context()
             self._sock = ctx.wrap_socket(self._sock, server_hostname=self.host)
         self._rfile = self._sock.makefile("rb", buffering=256 * 1024)
+        self._deadline: float | None = None  # set per recv() call
         self._handshake(open_timeout)
 
     # ── handshake ────────────────────────────────────────────────────────────
@@ -203,14 +205,36 @@ class RawWSClient:
     # ── recv ─────────────────────────────────────────────────────────────────
 
     def _read_exact(self, n: int) -> bytes:
-        data = self._rfile.read(n)
-        if data is None or len(data) < n:
-            raise WSConnectionClosed("socket closed mid-frame")
-        return data
+        """Exactly ``n`` bytes, re-arming the socket timeout from
+        ``self._deadline`` between underlying reads — a peer trickling
+        one byte per (almost-)timeout must exhaust the recv budget, not
+        reset it per read. ``read1`` issues at most one raw recv, so the
+        deadline is consulted every time the wire actually stalls."""
+        chunks: list[bytes] = []
+        got = 0
+        while got < n:
+            if self._deadline is not None:
+                remaining = self._deadline - time.monotonic()
+                if remaining <= 0:
+                    raise WSTimeout("websocket recv timed out")
+                self._sock.settimeout(remaining)
+            data = self._rfile.read1(n - got)
+            if not data:
+                raise WSConnectionClosed("socket closed mid-frame")
+            chunks.append(data)
+            got += len(data)
+        return chunks[0] if len(chunks) == 1 else b"".join(chunks)
 
     def recv(self, timeout: float | None = None) -> str | bytes:
         """Next data message (str for text frames, bytes for binary);
-        control frames are answered/absorbed inline."""
+        control frames are answered/absorbed inline. ``timeout`` bounds
+        the WHOLE message: one deadline spans the frame loop AND every
+        read inside a frame, so neither a slow trickle of fragments, a
+        ping storm, nor a byte-at-a-time payload can stretch one recv
+        far past the requested budget."""
+        self._deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
         self._sock.settimeout(timeout)
         try:
             fragments: list[bytes] = []
@@ -240,6 +264,14 @@ class RawWSClient:
                         pass
                     raise WSConnectionClosed("server sent close frame")
                 if opcode in (OP_TEXT, OP_BINARY):
+                    if frag_opcode is not None:
+                        # RFC 6455 §5.4: data frames may not interleave
+                        # with a fragmented message; silently dropping
+                        # the buffered fragments would corrupt the
+                        # stream position
+                        raise WSConnectionClosed(
+                            "data frame interleaved with fragments"
+                        )
                     if not (b0 & 0x80):  # fragmented message begins
                         frag_opcode, fragments = opcode, [payload]
                         continue

@@ -54,7 +54,14 @@ def _region(config: DeployConfig) -> str:
 
 
 class AWSServerfull(Provider):
-    """EC2-hosted node/network server (the mode the reference stubbed)."""
+    """EC2-hosted node/network server (the mode the reference stubbed).
+
+    Exposure: the app port's ingress defaults to 0.0.0.0/0 — the grid's
+    per-process JWT auth is OPTIONAL, and host-training/admin routes ride
+    the same endpoint, so restrict ``-var 'ingress_cidr=["10.0.0.0/8"]'``
+    to the clients' networks unless the process-level authentication
+    config is in force (the reference's serverless sat behind API
+    Gateway for the same reason)."""
 
     name = "aws-serverfull"
 
@@ -68,6 +75,17 @@ class AWSServerfull(Provider):
                 }
             },
             "provider": {"aws": {"region": _region(cfg)}},
+            "variable": {
+                "ingress_cidr": {
+                    "type": "list(string)",
+                    "default": ["0.0.0.0/0"],
+                    "description": (
+                        "CIDRs allowed to reach the grid port; default "
+                        "open — narrow it unless per-process JWT auth "
+                        "is configured (see class docstring)"
+                    ),
+                }
+            },
             "resource": {
                 "aws_security_group": {
                     "grid_ingress": {
@@ -77,7 +95,7 @@ class AWSServerfull(Provider):
                                 "from_port": app.port,
                                 "to_port": app.port,
                                 "protocol": "tcp",
-                                "cidr_blocks": ["0.0.0.0/0"],
+                                "cidr_blocks": "${var.ingress_cidr}",
                                 "description": "grid WS/HTTP",
                                 "ipv6_cidr_blocks": [],
                                 "prefix_list_ids": [],
@@ -150,6 +168,12 @@ class AWSServerless(Provider):
     request/response bridge container Lambdas need to front an HTTP
     server; ``AWS_LWA_PORT`` is wired for it) — the repo's
     ``Dockerfile.lambda`` builds exactly that image.
+
+    Exposure: the Function URL uses ``authorization_type = NONE`` —
+    public by design, like the reference's unauthenticated API Gateway
+    stage — so a production deployment should configure per-process JWT
+    auth (``server_config.authentication``) or front the URL with IAM
+    auth/CloudFront; host-training/admin routes ride the same endpoint.
 
     Scope honesty: a Function URL speaks request/response HTTP only —
     NO WebSockets. The node's full model-centric flow has HTTP mirrors

@@ -182,6 +182,12 @@ def accum_f32(acc: np.ndarray, src, weight: float = 1.0) -> None:
         raise ValueError(f"accum_f32 size mismatch: {src.size} != {acc.size}")
     if _lib is not None and acc.size:
         src = np.ascontiguousarray(src, dtype=np.float32)
+        # np.frombuffer over a msgpack blob can sit at any byte offset
+        # and ascontiguousarray does NOT realign — dereferencing an
+        # unaligned const float* is UB in C (works on x86-64, can trap
+        # on stricter targets)
+        if src.ctypes.data % src.itemsize:
+            src = src.copy()
         _lib.pg_accum_f32(
             acc.ctypes.data, src.ctypes.data, float(weight), acc.size
         )
@@ -202,6 +208,9 @@ def accum_bf16(acc: np.ndarray, src, weight: float = 1.0) -> None:
         raise ValueError(f"accum_bf16 size mismatch: {src.size} != {acc.size}")
     if _lib is not None and acc.size:
         src = np.ascontiguousarray(src, dtype=np.uint16)
+        # same unaligned-wire-offset hazard as accum_f32 above
+        if src.ctypes.data % src.itemsize:
+            src = src.copy()
         _lib.pg_accum_bf16(
             acc.ctypes.data, src.ctypes.data, float(weight), acc.size
         )
