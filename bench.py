@@ -25,6 +25,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -646,6 +647,150 @@ def bench_fed_transformer() -> dict:
     }
 
 
+def bench_data_centric() -> dict:
+    """Data-centric plane measured (SURVEY §6 row 3) in a CPU-pinned
+    SUBPROCESS: the node-side pointer/plan/Beaver ops execute on the
+    session's jax platform, and on a TPU-reachable capture every tiny
+    64×64 add would ride the 20-70 ms tunnel — the metric would measure
+    tunnel state, not the protocol plane (the reference analog is
+    torch-CPU ops behind Flask). The subprocess pins jax to CPU the same
+    way the scale-out replicas do."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.config.update('jax_platforms', 'cpu');"
+                "import json, bench;"
+                "print(json.dumps(bench._bench_data_centric_impl()))",
+            ],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+    except subprocess.TimeoutExpired:
+        print("data-centric bench subprocess timed out", file=sys.stderr)
+        return {"datacentric_error": "subprocess timeout"}
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        return {"datacentric_error": f"rc={proc.returncode}"}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _bench_data_centric_impl() -> dict:
+    """The measurement itself (run CPU-pinned; see bench_data_centric):
+    pointer-op round-trips/sec and remote plan execs/sec against a live
+    node over real WS frames (reference workload
+    ``examples/data-centric/mnist/02-FL-mnist-train-model.ipynb`` cells
+    20-22), plus one §3.5 encrypted-inference latency — share → network
+    discover → cross-node Beaver rounds → reconstruct — over an
+    in-process 4-node grid."""
+    import numpy as np
+
+    from pygrid_tpu.client import DataCentricFLClient
+    from pygrid_tpu.plans.plan import Plan
+    from pygrid_tpu.runtime import PointerTensor, messages as M
+
+    out: dict = {}
+    server = _NodeServer().start()
+    try:
+        client = DataCentricFLClient(server.url)
+        x = np.random.RandomState(0).randn(64, 64).astype(np.float32)
+        ptr = client.send(x)
+        _ = (ptr + ptr).get()  # warm incl. the node-side add dispatch
+        N = 40
+        t0 = time.perf_counter()
+        for _ in range(N):
+            a = client.send(x)
+            b = a + a
+            _ = b.get()
+        dt = time.perf_counter() - t0
+        # send + remote add + get = 3 WS request/response round trips
+        out["datacentric_pointer_roundtrips_per_sec"] = round(3 * N / dt, 1)
+
+        plan = Plan(name="bench-affine", fn=lambda v: v * 2.0 + 1.0)
+        plan.build(np.zeros((64, 64), np.float32))
+        resp = client.recv_obj_msg(M.ObjectMessage(obj=plan, id=424242))
+        plan_ptr = PointerTensor(client, resp.id_at_location)
+        r = client.run_plan(plan_ptr, x)  # warm (compile server-side)
+        np.testing.assert_allclose(r.get(), x * 2.0 + 1.0, rtol=1e-5)
+        t0 = time.perf_counter()
+        for _ in range(N):
+            client.run_plan(plan_ptr, x)
+        dt = time.perf_counter() - t0
+        out["datacentric_plan_execs_per_sec"] = round(N / dt, 1)
+        client.close()
+        print(
+            f"data-centric: {out['datacentric_pointer_roundtrips_per_sec']}"
+            " pointer round-trips/sec, "
+            f"{out['datacentric_plan_execs_per_sec']} remote plan execs/sec"
+            f" (64x64 f32, live node)",
+            file=sys.stderr,
+        )
+    finally:
+        server.stop()
+
+    # §3.5 encrypted inference over a 4-node grid (examples/_grid spawns
+    # the same in-process topology the integration suite uses)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "examples"))
+    from _grid import spawn_grid
+
+    from pygrid_tpu.smpc import EncryptedModel, publish_encrypted_model
+
+    network_url, nodes = spawn_grid(4)
+    rng = np.random.default_rng(0)
+    weights = [
+        rng.uniform(-0.5, 0.5, (4, 3)).astype(np.float32),
+        rng.uniform(-0.2, 0.2, (3,)).astype(np.float32),
+        rng.uniform(-0.5, 0.5, (3, 2)).astype(np.float32),
+        rng.uniform(-0.2, 0.2, (2,)).astype(np.float32),
+    ]
+
+    def forward(x, w1, b1, w2, b2):
+        # CryptoNets-style polynomial circuit (affine → square → affine):
+        # data-dependent nonlinearities need comparison protocols the
+        # ring doesn't give for free (examples/encrypted_inference.py)
+        h = x @ w1 + b1
+        h = h * h
+        return h @ w2 + b2
+
+    plan = Plan(name="encrypted_forward", fn=forward)
+    plan.build(np.zeros((2, 4), np.float32), *weights)
+    clients = {n: DataCentricFLClient(url) for n, url in nodes.items()}
+    publish_encrypted_model(
+        plan,
+        "bench-encrypted-mlp",
+        host_client=clients["alice"],
+        holder_clients=[clients["alice"], clients["bob"], clients["charlie"]],
+        provider_client=clients["dan"],
+        weights=weights,
+    )
+    model = EncryptedModel.discover(network_url, "bench-encrypted-mlp")
+    xq = rng.uniform(-1, 1, (2, 4)).astype(np.float32)
+    _ = model.predict(xq)  # warm (crypto-store refill + compiles)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _ = model.predict(xq)
+        times.append(time.perf_counter() - t0)
+    out["encrypted_inference_ms"] = round(min(times) * 1e3, 1)
+    model.close()
+    for c in clients.values():
+        c.close()
+    print(
+        f"encrypted inference[4-node grid, 2-layer MLP]: "
+        f"{out['encrypted_inference_ms']} ms per predict "
+        "(share discovery + cross-node Beaver rounds + reconstruct)",
+        file=sys.stderr,
+    )
+    return out
+
+
 def bench_report_handler() -> dict:
     """Isolated node-side report-handler latency (no sockets, no client
     threads): p50 ``route_requests`` time for a protocol-realistic report
@@ -822,6 +967,7 @@ def main() -> None:
     proto = bench_protocol("json")
     proto.update(bench_protocol("binary"))
     proto.update(bench_report_handler())
+    proto.update(bench_data_centric())
     if tpu_ok:
         proto.update(bench_smpc())
         proto.update(bench_attention())
