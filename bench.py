@@ -349,6 +349,73 @@ def bench_attention() -> dict:
     }
 
 
+def bench_attention_train() -> dict:
+    """Causal attention TRAINING step (fwd + backward gradients) at
+    L=4096 B=4 H=8 D=128 bf16: the Pallas flash VJP (two backward
+    kernels, causal block pruning) vs differentiating the XLA dense
+    path. Training is ~3× the forward FLOPs, so this — not the fwd-only
+    line above — is the number long-context training rides on."""
+    import jax
+    import jax.numpy as jnp
+
+    from pygrid_tpu.parallel.pallas_attention import flash_attention
+    from pygrid_tpu.parallel.ring_attention import attention
+
+    B, L, H, D = 4, 4096, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, L, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, L, H, D), jnp.bfloat16)
+
+    def marginal(attn, lo=2, hi=10, trials=5):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v, causal=True).astype(jnp.float32))
+
+        g = jax.grad(loss, argnums=(0, 1, 2))
+
+        def chain(n):
+            @jax.jit
+            def f(q, k, v):
+                def body(carry, _):
+                    qq, kk, vv = carry
+                    dq, dk, dv = g(qq, kk, vv)
+                    return (
+                        qq + dq * 1e-6, kk + dk * 1e-6, vv + dv * 1e-6
+                    ), dq[0, 0, 0, 0]
+
+                _, outs = jax.lax.scan(body, (q, k, v), None, length=n)
+                return outs[-1]
+
+            return f
+
+        fns = {n: chain(n) for n in (lo, hi)}
+        for f in fns.values():
+            _ = float(f(q, k, v))
+
+        def run(n):
+            t0 = time.perf_counter()
+            _ = float(fns[n](q, k, v))
+            return time.perf_counter() - t0
+
+        t_lo = min(run(lo) for _ in range(trials))
+        t_hi = min(run(hi) for _ in range(trials))
+        return (t_hi - t_lo) / (hi - lo)
+
+    t_flash = marginal(flash_attention)
+    t_xla = marginal(attention)
+    print(
+        f"attention-train[causal L={L} B={B} H={H} D={D} bf16]: "
+        f"flash fwd+bwd {t_flash*1e3:.2f} ms vs xla VJP {t_xla*1e3:.2f} ms "
+        f"({t_xla/t_flash:.2f}x)",
+        file=sys.stderr,
+    )
+    return {
+        "attention_flash_train_ms": round(t_flash * 1e3, 2),
+        "attention_xla_train_ms": round(t_xla * 1e3, 2),
+        "attention_flash_train_speedup": round(t_xla / t_flash, 2),
+    }
+
+
 # --- protocol plane ----------------------------------------------------------
 
 
@@ -571,12 +638,23 @@ def _bench_protocol_once(wire: str) -> dict:
         server.stop()
 
 
-def bench_fed_transformer() -> dict:
-    """Flagship composition bench: FedAvg over vmapped TRANSFORMER clients
-    with the Pallas flash-attention kernel inside every client step —
-    kernel plane, flash kernel and federated aggregation in one compiled
-    program (the three existed separately through round 3; this measures
-    them composed). Reports tokens/sec and MFU."""
+def _transformer_round_time(
+    cfg, Kc: int, Bc: int, remat: bool, small: int, large: int,
+    trials: int = 5,
+) -> tuple[float, float, int]:
+    """(sec/round, FLOPs/round, tokens/round) for a FedAvg round over
+    vmapped transformer clients with the Pallas flash kernels — the ONE
+    FLOPs model and marginal-timing harness both transformer benches
+    share (a correction here moves every fed_transformer_* metric
+    together, keeping cross-round comparability).
+
+    FLOPs: 6ND for the matmul path (attn + mlp + tied output proj) plus
+    the attention score/value quadratic term (~12·L·d per token PER
+    LAYER, fwd+bwd, counted dense).
+
+    NOTE: no global matmul_precision override here — a DotAlgorithmPreset
+    context leaks into the Pallas kernel's own dots and Mosaic's lowering
+    rejects it; the flash kernel manages its precision internally."""
     import jax
     import jax.numpy as jnp
 
@@ -584,15 +662,8 @@ def bench_fed_transformer() -> dict:
     from pygrid_tpu.parallel import make_scanned_rounds
     from pygrid_tpu.parallel.pallas_attention import flash_attention
 
-    cfg = transformer.TransformerConfig(
-        vocab=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048,
-        max_len=512,
-    )
-    Kc, Bc, L = 8, 4, 512
+    L = cfg.max_len
     tokens_per_round = Kc * Bc * L
-    # 6ND for the matmul path (attn + mlp + tied output proj) plus the
-    # attention score/value quadratic term (~12·L·d per token PER LAYER,
-    # fwd+bwd)
     n_matmul = cfg.n_layers * (
         4 * cfg.d_model**2 + 2 * cfg.d_model * cfg.d_ff
     ) + cfg.vocab * cfg.d_model
@@ -600,19 +671,13 @@ def bench_fed_transformer() -> dict:
         6.0 * n_matmul * tokens_per_round
         + 12.0 * cfg.n_layers * L * cfg.d_model * tokens_per_round
     )
-
     step = transformer.make_training_step(
-        cfg, attn_fn=flash_attention, compute_dtype="bfloat16"
+        cfg, attn_fn=flash_attention, compute_dtype="bfloat16", remat=remat
     )
     params = transformer.init(jax.random.PRNGKey(0), cfg)
     X = jax.random.randint(jax.random.PRNGKey(1), (Kc, Bc, L), 0, cfg.vocab)
     y = jnp.roll(X, -1, axis=-1)
     lr = jnp.float32(0.1)
-
-    # NOTE: no global matmul_precision override here — a DotAlgorithmPreset
-    # context leaks into the Pallas kernel's own dots and Mosaic's lowering
-    # rejects it; the flash kernel manages its precision internally
-    small, large = 2, 10
     fns = {
         n: make_scanned_rounds(step, n_rounds=n) for n in (small, large)
     }
@@ -626,25 +691,80 @@ def bench_fed_transformer() -> dict:
         _ = float(out[1][-1])
         return time.perf_counter() - t0
 
-    t_small = min(run(small) for _ in range(5))
-    t_large = min(run(large) for _ in range(5))
+    t_small = min(run(small) for _ in range(trials))
+    t_large = min(run(large) for _ in range(trials))
     per = (t_large - t_small) / (large - small)
-    tok_s = tokens_per_round / per
+    return per, flops_round, tokens_per_round
+
+
+def bench_fed_transformer() -> dict:
+    """Flagship composition bench: FedAvg over vmapped TRANSFORMER clients
+    with the Pallas flash-attention kernel inside every client step —
+    kernel plane, flash kernel and federated aggregation in one compiled
+    program (the three existed separately through round 3; this measures
+    them composed). Reports tokens/sec and MFU."""
+    from pygrid_tpu.models import transformer
+
+    # n_heads=4 → head_dim 128 = the MXU lane width: the TPU-native
+    # head layout (dh=64 forces the kernel to pad every head to 128
+    # lanes — measured 6 ms/round of pure padding waste at this scale).
+    # Same d_model/layers/FLOPs; MFU is head-count independent.
+    cfg = transformer.TransformerConfig(
+        vocab=8192, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
+        max_len=512,
+    )
+    Kc, Bc = 8, 4
+    per, flops_round, tokens = _transformer_round_time(
+        cfg, Kc, Bc, remat=False, small=2, large=10
+    )
+    tok_s = tokens / per
     mfu = flops_round / per / (PEAK_TFLOPS * 1e12)
     print(
-        f"fed-transformer[{cfg.n_layers}L d{cfg.d_model} L={L} flash]: "
-        f"{per*1e3:.1f} ms/round, {tok_s:,.0f} tokens/sec, "
-        f"MFU {mfu*100:.1f}% ({Kc} clients × {Bc}×{L} tokens)",
+        f"fed-transformer[{cfg.n_layers}L d{cfg.d_model} L={cfg.max_len} "
+        f"flash]: {per*1e3:.1f} ms/round, {tok_s:,.0f} tokens/sec, "
+        f"MFU {mfu*100:.1f}% ({Kc} clients × {Bc}×{cfg.max_len} tokens)",
         file=sys.stderr,
     )
     return {
         "fed_transformer_tokens_per_sec": round(tok_s, 0),
         "fed_transformer_mfu_pct": round(mfu * 100, 1),
         "fed_transformer_ms_per_round": round(per * 1e3, 2),
-        # recorded so cross-round comparisons never mistake a dtype
-        # change for an optimization
+        # recorded so cross-round comparisons never mistake a dtype or
+        # layout change for an optimization
         "fed_transformer_compute_dtype": "bfloat16",
+        "fed_transformer_head_dim": cfg.d_model // cfg.n_heads,
     }
+
+
+def bench_fed_transformer_long() -> dict:
+    """Long-context federated-transformer TRAINING — the framework's
+    stated differentiator (SURVEY §5.7) measured end-to-end instead of
+    as kernel microbenchmarks: full training rounds at L=4096 and
+    L=8192 with ``remat`` + the Pallas flash kernels in BOTH directions
+    (the XLA dense path cannot even materialize the L=8192 scores).
+    Emits ``fed_transformer_long_{L}_*`` tokens/sec + MFU."""
+    from pygrid_tpu.models import transformer
+
+    out: dict = {}
+    for L, Kc in ((4096, 8), (8192, 4)):
+        cfg = transformer.TransformerConfig(
+            vocab=8192, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
+            max_len=L,
+        )
+        per, flops_round, tokens = _transformer_round_time(
+            cfg, Kc, 1, remat=True, small=1, large=4, trials=4
+        )
+        tok_s = tokens / per
+        mfu = flops_round / per / (PEAK_TFLOPS * 1e12)
+        print(
+            f"fed-transformer-long[L={L} {Kc}×1 remat flash]: "
+            f"{per*1e3:.1f} ms/round, {tok_s:,.0f} tokens/sec, "
+            f"MFU {mfu*100:.1f}%",
+            file=sys.stderr,
+        )
+        out[f"fed_transformer_long_{L}_tokens_per_sec"] = round(tok_s, 0)
+        out[f"fed_transformer_long_{L}_mfu_pct"] = round(mfu * 100, 1)
+    return out
 
 
 def bench_data_centric() -> dict:
@@ -971,7 +1091,9 @@ def main() -> None:
     if tpu_ok:
         proto.update(bench_smpc())
         proto.update(bench_attention())
+        proto.update(bench_attention_train())
         proto.update(bench_fed_transformer())
+        proto.update(bench_fed_transformer_long())
     cpu_rps = bench_cpu_torch_baseline()
     # headline = the faster of the two identical-output kernel shapes
     # (identity asserted in tests/unit/test_fedavg_sim.py); both reported

@@ -40,12 +40,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _wait_up(url: str, proc: subprocess.Popen, timeout: float = 90.0) -> None:
+def _wait_up(
+    url: str, proc: subprocess.Popen, log: pathlib.Path,
+    timeout: float = 90.0,
+) -> None:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if proc.poll() is not None:
-            out = proc.stdout.read() if proc.stdout else ""
-            raise AssertionError(f"replica died:\n{out[-3000:]}")
+            raise AssertionError(f"replica died:\n{log.read_text()[-3000:]}")
         try:
             requests.get(url + "/", timeout=2)
             return
@@ -65,20 +67,25 @@ def replicas(tmp_path):
     # subprocesses must not touch the (possibly dark) TPU tunnel
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    procs, urls = [], []
-    for _ in range(2):
+    procs, urls, logs = [], [], []
+    for i in range(2):
         port = _free_port()
+        # log to a FILE, never an undrained PIPE: a replica can emit an
+        # access-log line per poll request, and a full 64 KB pipe buffer
+        # would block its event loop mid-test
+        log = tmp_path / f"replica{i}.log"
+        logs.append(log)
         p = subprocess.Popen(
             [sys.executable, "-m", "pygrid_tpu.node", "--id", "shared",
              "--port", str(port)],
-            env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+            env=env, cwd=str(tmp_path), stdout=log.open("w"),
             stderr=subprocess.STDOUT, text=True,
         )
         procs.append(p)
         urls.append(f"http://127.0.0.1:{port}")
     try:
-        for url, p in zip(urls, procs):
-            _wait_up(url, p)
+        for url, p, log in zip(urls, procs, logs):
+            _wait_up(url, p, log)
         yield urls
     finally:
         for p in procs:

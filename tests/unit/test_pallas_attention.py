@@ -172,6 +172,39 @@ def test_transformer_trains_with_flash_attention():
         )
 
 
+def test_transformer_remat_flash_training_step():
+    """remat + flash in both directions — the composition the
+    long-context training bench runs (jax.checkpoint re-traces the
+    block, so the Pallas VJP must survive a second trace)."""
+    from functools import partial
+
+    from pygrid_tpu.models import transformer
+    from pygrid_tpu.parallel import make_scanned_rounds
+
+    cfg = transformer.TransformerConfig(
+        vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=2, max_len=64
+    )
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    flash = partial(flash_attention, interpret=True)
+    step_plain = transformer.make_training_step(cfg, attn_fn=flash)
+    step_remat = transformer.make_training_step(
+        cfg, attn_fn=flash, remat=True
+    )
+    X = jax.random.randint(jax.random.PRNGKey(1), (2, 2, 64), 0, 64)
+    y = jax.random.randint(jax.random.PRNGKey(2), (2, 2, 64), 0, 64)
+    lr = jnp.float32(0.1)
+    out_p = make_scanned_rounds(step_plain, n_rounds=2)(params, X, y, lr)
+    out_r = make_scanned_rounds(step_remat, n_rounds=2)(params, X, y, lr)
+    # remat changes memory, never math
+    np.testing.assert_allclose(
+        np.asarray(out_p[1]), np.asarray(out_r[1]), rtol=1e-5
+    )
+    for a, b in zip(out_p[0], out_r[0]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
 def test_plugs_into_transformer_attn_fn():
     """The kernel satisfies the transformer's injectable attn_fn contract
     (same [B, L, H, D] signature as `attention`)."""
