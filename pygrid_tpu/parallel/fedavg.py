@@ -10,8 +10,10 @@ reduce loop — cycle_manager.py:275-290):
 - On a device mesh the client axis is **sharded**; the average is a
   ``psum``/``pmean`` over the ``"clients"`` mesh axis riding ICI
   (:func:`make_sharded_round` via ``shard_map``).
-- One FedAvg round — local steps, diffing, aggregation, model update — is a
-  single compiled XLA program either way.
+- One FedAvg round — local steps, aggregation, model update — is a single
+  compiled XLA program either way. Aggregation is reassociated from the
+  protocol form (``params - mean_k(diff_k)``) to ``mean_k(new_p_k)``:
+  same update, but no K-sized diff tensors ever exist on device.
 """
 
 from __future__ import annotations
@@ -64,13 +66,14 @@ def make_round(
             new_p, loss, acc = _client_update(
                 training_step, params, X, y, lr, local_steps
             )
-            diffs = [p - n for p, n in zip(params, new_p)]
-            return diffs, loss, acc
+            return new_p, loss, acc
 
         def body():
-            diffs, losses, accs = jax.vmap(one_client)(client_X, client_y)
-            avg_diff = [jnp.mean(d, axis=0) for d in diffs]
-            new_params = [p - d for p, d in zip(params, avg_diff)]
+            # params - mean_k(p - new_p_k) reassociated to mean_k(new_p_k):
+            # same FedAvg update, but the K per-client diff tensors — pure
+            # HBM traffic at scale — are never materialized
+            new_ps, losses, accs = jax.vmap(one_client)(client_X, client_y)
+            new_params = [jnp.mean(n, axis=0) for n in new_ps]
             return new_params, jnp.mean(losses), jnp.mean(accs)
 
         if matmul_precision is None:
@@ -89,10 +92,11 @@ def make_sharded_round(
 ) -> Callable:
     """FedAvg round with the client axis sharded over the mesh.
 
-    Each device trains its shard of clients (vmap inside the shard), then the
-    global average diff is a ``pmean`` over the mesh axis — the collective
-    rides ICI instead of the reference's socket fan-in. Params/results are
-    replicated; client data is sharded on its leading axis.
+    Each device trains its shard of clients (vmap inside the shard), then
+    the new global params are a ``pmean`` of the shard-local client-mean
+    params over the mesh axis — the collective rides ICI instead of the
+    reference's socket fan-in. Params/results are replicated; client data
+    is sharded on its leading axis.
     """
 
     def shard_fn(params, client_X, client_y, lr):
@@ -109,14 +113,14 @@ def make_sharded_round(
             new_p, loss, acc = _client_update(
                 training_step, params_v, X, y, lr_v, local_steps
             )
-            return [p - n for p, n in zip(params_v, new_p)], loss, acc
+            return new_p, loss, acc
 
-        diffs, losses, accs = jax.vmap(one_client)(client_X, client_y)
+        new_ps, losses, accs = jax.vmap(one_client)(client_X, client_y)
         # local mean then pmean over the mesh axis == global mean (equal
-        # shard sizes — enforced by the sharding)
-        local_avg = [jnp.mean(d, axis=0) for d in diffs]
-        avg_diff = [lax.pmean(d, axis) for d in local_avg]
-        new_params = [p - d for p, d in zip(params, avg_diff)]
+        # shard sizes — enforced by the sharding); the mean is over
+        # new params directly — see make_round's reassociation note
+        local_avg = [jnp.mean(n, axis=0) for n in new_ps]
+        new_params = [lax.pmean(n, axis) for n in local_avg]
         return new_params, lax.pmean(jnp.mean(losses), axis), lax.pmean(
             jnp.mean(accs), axis
         )
@@ -149,15 +153,15 @@ def make_scanned_rounds(
 
     ``fold_clients=True`` (requires ``local_steps == 1``) exploits the
     FedAvg identity: with one local step of a mean-loss gradient update,
-    ``mean_k(diff_k) = step(params, concat_k(data))`` — the K·B samples
+    ``mean_k(new_p_k) = step(params, concat_k(data))`` — the K·B samples
     fold into one batch before the first matmul. Results are identical
     (same algorithm, reassociated); the win is a roofline shift: the
-    per-client path materializes K per-client weight diffs (the [K, 784,
-    392] tensor dominates HBM traffic, ~2.5 GB/round at K=1024 —
-    bandwidth-bound at ~35% MFU), while the folded path writes one. Only
-    valid for update rules linear in the gradient of a mean-reduced loss
-    (plain SGD — what the reference's workload runs); momentum/adam
-    per-client states break the identity, hence opt-in.
+    per-client path materializes K per-client NEW-param tensors (the
+    [K, 784, 392] carry dominates HBM traffic, ~1.3 GB/round at K=1024 —
+    bandwidth-bound), while the folded path writes one. Only valid for
+    update rules linear in the gradient of a mean-reduced loss (plain
+    SGD — what the reference's workload runs); momentum/adam per-client
+    states break the identity, hence opt-in.
     """
     if fold_clients and local_steps != 1:
         raise ValueError(
@@ -171,14 +175,15 @@ def make_scanned_rounds(
             new_p, loss, acc = _client_update(
                 training_step, p, X, y, lr, local_steps
             )
-            return [a - b for a, b in zip(p, new_p)], loss, acc
+            return new_p, loss, acc
 
         def one_round(p, _):
-            diffs, losses, accs = jax.vmap(
+            # mean over per-client NEW params (see make_round) — the K
+            # per-client diff tensors stay unmaterialized
+            new_ps, losses, accs = jax.vmap(
                 lambda X, y: one_client(p, X, y)
             )(client_X, client_y)
-            avg_diff = [jnp.mean(d, axis=0) for d in diffs]
-            new_params = [a - d for a, d in zip(p, avg_diff)]
+            new_params = [jnp.mean(n, axis=0) for n in new_ps]
             return new_params, (jnp.mean(losses), jnp.mean(accs))
 
         def one_round_folded(p, _):
