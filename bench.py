@@ -40,11 +40,30 @@ LR = 0.1
 #: used 10 and mis-ranked the two kernel paths outright (see bench_tpu).
 TIMED_ROUNDS = 190
 
-PROTO_WORKERS = int(os.environ.get("PYGRID_BENCH_WORKERS", "64"))
-PROTO_CYCLES = int(os.environ.get("PYGRID_BENCH_CYCLES", "2"))
-PROTO_DEADLINE = float(os.environ.get("PYGRID_BENCH_DEADLINE", "240"))
+def _env_num(name: str, default, cast, allow_zero: bool = False):
+    """Env knob with a defensive parse: a malformed value (``45s``,
+    ``3.0`` for an int, a negative) must degrade to the default, not
+    crash the bench before its one JSON line is printed."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = cast(raw)
+    except ValueError:
+        print(
+            f"ignoring malformed {name}={raw!r}; using {default}",
+            file=sys.stderr,
+        )
+        return default
+    floor = 0 if allow_zero else 1
+    return value if value >= floor else default
+
+
+PROTO_WORKERS = _env_num("PYGRID_BENCH_WORKERS", 64, int)
+PROTO_CYCLES = _env_num("PYGRID_BENCH_CYCLES", 2, int)
+PROTO_DEADLINE = _env_num("PYGRID_BENCH_DEADLINE", 240.0, float)
 #: bf16 peak of the bench chip (v5e ≈ 197 TFLOP/s); override per platform
-PEAK_TFLOPS = float(os.environ.get("PYGRID_PEAK_TFLOPS", "197"))
+PEAK_TFLOPS = _env_num("PYGRID_PEAK_TFLOPS", 197.0, float)
 
 
 def _flops_per_round() -> float:
@@ -1010,7 +1029,7 @@ def bench_report_handler() -> dict:
 #: in-session: even a 1000x1000 matmul fetch never returns). Rather than the
 #: driver recording nothing, emit an honest JSON line and exit. Generous
 #: default — first TPU compiles are ~20-40s, full bench minutes.
-BENCH_TIMEOUT = float(os.environ.get("PYGRID_BENCH_TIMEOUT", "1500"))
+BENCH_TIMEOUT = _env_num("PYGRID_BENCH_TIMEOUT", 1500.0, float)
 
 
 def _arm_watchdog() -> threading.Timer:
@@ -1035,10 +1054,14 @@ def _arm_watchdog() -> threading.Timer:
     return timer
 
 
-def _tpu_reachable(probe_timeout: float = 120.0) -> bool:
+def _tpu_reachable(probe_timeout: float = 120.0) -> tuple[bool, bool]:
     """Probe the accelerator in a SUBPROCESS: a dark tunnel hangs the first
     device call forever (observed in-session), and a hung probe must not
-    take the bench with it."""
+    take the bench with it. Returns ``(ok, retryable)`` — timeouts and
+    transient-looking failures (tunnel flaps present as hangs OR fast
+    connection errors) are worth retrying; an unambiguous environment
+    error (jax not importable) or a clean CPU-only answer will not heal
+    in 45 seconds."""
     import subprocess
 
     code = (
@@ -1053,7 +1076,20 @@ def _tpu_reachable(probe_timeout: float = 120.0) -> bool:
             timeout=probe_timeout,
         )
         if proc.returncode != 0:
-            return False
+            # tunnel flaps often fail FAST (connection refused /
+            # UNAVAILABLE), so speed alone cannot mean deterministic —
+            # only an unambiguous environment error does; everything
+            # else gets the (budget-bounded) retries
+            stderr = proc.stderr.decode(errors="replace")
+            deterministic = any(
+                marker in stderr
+                for marker in (
+                    "ModuleNotFoundError",
+                    "ImportError",
+                    "No module named",
+                )
+            )
+            return False, not deterministic
         # the device must actually BE an accelerator ('tpu', or 'axon'
         # tunneling a 'TPU v5 lite' chip) — a silent CPU fallback must not
         # record TPU-labeled numbers against the 197-TFLOP peak
@@ -1065,14 +1101,93 @@ def _tpu_reachable(probe_timeout: float = 120.0) -> bool:
             ),
             "",
         )
-        return "tpu" in device_line.lower()
+        # a clean CPU answer is deterministic (no accelerator plugin)
+        # UNLESS stderr shows the TPU backend failing to initialize —
+        # a dark tunnel can present as a silent CPU fallback, and that
+        # flavor of outage is exactly what the retries are for
+        stderr = proc.stderr.decode(errors="replace")
+        tpu_init_failed = any(
+            marker in stderr
+            for marker in (
+                "Unable to initialize backend",
+                "UNAVAILABLE",
+                "DEADLINE_EXCEEDED",
+                "failed to connect",
+            )
+        )
+        return "tpu" in device_line.lower(), tpu_init_failed
     except subprocess.TimeoutExpired:
-        return False
+        return False, True
+
+
+def _tpu_reachable_with_retry() -> bool:
+    """Retry the probe a few times before declaring an outage: the tunnel
+    has been observed to flap (dark for one probe, back the next), and a
+    single 120s-timeout sample turning the whole TPU section of the round
+    record to nulls is a worse failure than ~3 extra probe minutes.
+    Bounded so a hard-down tunnel still leaves the watchdog plenty of
+    budget for the protocol-only bench."""
+    attempts = max(1, _env_num("PYGRID_BENCH_PROBE_RETRIES", 3, int))
+    delay = _env_num("PYGRID_BENCH_PROBE_DELAY", 45.0, float, allow_zero=True)
+    # hard cap: probing may consume at most a third of the watchdog budget
+    # — however the env knobs are set, the protocol-only fallback must
+    # still get its turn before _arm_watchdog's timer fires the null record
+    deadline = time.monotonic() + min(BENCH_TIMEOUT / 3.0, 600.0)
+    exhausted = "TPU probe retry budget exhausted — declaring outage"
+    for i in range(attempts):
+        # every probe (including the first) is clamped to the remaining
+        # budget so the stated cap holds for any PYGRID_BENCH_TIMEOUT;
+        # a clamped short retry still beats declaring an outage
+        probe_timeout = min(120.0, deadline - time.monotonic())
+        if probe_timeout <= 5.0:
+            print(exhausted, file=sys.stderr)
+            break
+        ok, retryable = _tpu_reachable(probe_timeout=probe_timeout)
+        if ok:
+            return True
+        if not retryable:
+            print(
+                "TPU probe failed deterministically — not retrying",
+                file=sys.stderr,
+            )
+            break
+        if i + 1 >= attempts:
+            break
+        if deadline - (time.monotonic() + delay) <= 5.0:
+            print(exhausted, file=sys.stderr)
+            break
+        print(
+            f"TPU probe {i + 1}/{attempts} failed — retrying in "
+            f"{delay:.0f}s",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
+    return False
+
+
+def _guard_call(section: str, fn, out: dict, default=None):
+    """Run one bench section; a failure records ``{section}_error`` and
+    returns ``default`` so the capture continues. One kernel that won't
+    Mosaic-compile on the round's chip must cost its own metrics, not the
+    whole record."""
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — survive any section failure
+        msg = f"{type(e).__name__}: {e}"
+        print(f"bench section {section} FAILED: {msg}", file=sys.stderr)
+        out[f"{section}_error"] = msg[:300]
+        return default
+
+
+def _guard(section: str, fn, out: dict) -> None:
+    """Dict-returning section variant of :func:`_guard_call`."""
+    out.update(_guard_call(section, fn, out, default={}))
 
 
 def main() -> None:
     watchdog = _arm_watchdog()
-    tpu_ok = _tpu_reachable()
+    tpu_ok = _tpu_reachable_with_retry()
+    proto: dict = {}
     if not tpu_ok:
         # record what CAN be measured (protocol plane + CPU baseline on the
         # host platform) with the outage marked — a partial honest record
@@ -1083,41 +1198,50 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         tpu_rps = mfu = tpu_rps_folded = mfu_folded = None
     else:
-        tpu_rps, mfu, tpu_rps_folded, mfu_folded = bench_tpu()
-    proto = bench_protocol("json")
-    proto.update(bench_protocol("binary"))
-    proto.update(bench_report_handler())
-    proto.update(bench_data_centric())
+        tpu_rps, mfu, tpu_rps_folded, mfu_folded = _guard_call(
+            "kernel", bench_tpu, proto, default=(None,) * 4
+        )
+    _guard("protocol_json", lambda: bench_protocol("json"), proto)
+    _guard("protocol_binary", lambda: bench_protocol("binary"), proto)
+    _guard("report_handler", bench_report_handler, proto)
+    _guard("datacentric", bench_data_centric, proto)
     if tpu_ok:
-        proto.update(bench_smpc())
-        proto.update(bench_attention())
-        proto.update(bench_attention_train())
-        proto.update(bench_fed_transformer())
-        proto.update(bench_fed_transformer_long())
-    cpu_rps = bench_cpu_torch_baseline()
+        _guard("smpc", bench_smpc, proto)
+        _guard("attention", bench_attention, proto)
+        _guard("attention_train", bench_attention_train, proto)
+        _guard("fed_transformer", bench_fed_transformer, proto)
+        _guard("fed_transformer_long", bench_fed_transformer_long, proto)
+    cpu_rps = _guard_call("cpu_baseline", bench_cpu_torch_baseline, proto)
     # headline = the faster of the two identical-output kernel shapes
     # (identity asserted in tests/unit/test_fedavg_sim.py); both reported
-    if tpu_ok and tpu_rps_folded > tpu_rps:
+    kernel_ok = tpu_ok and tpu_rps is not None
+    if kernel_ok and tpu_rps_folded > tpu_rps:
         best_rps, best_mfu = tpu_rps_folded, mfu_folded
     else:
         best_rps, best_mfu = tpu_rps, mfu
     result = {
         "metric": "fedavg_rounds_per_sec_1k_clients",
-        "value": round(best_rps, 3) if tpu_ok else None,
+        "value": round(best_rps, 3) if kernel_ok else None,
         "unit": "rounds/sec (1024 simulated MNIST-MLP clients, batch 64)",
-        "vs_baseline": round(best_rps / cpu_rps, 1) if tpu_ok else None,
-        "mfu_pct": round(best_mfu * 100, 1) if tpu_ok else None,
-        "fedavg_rounds_per_sec_per_client_path": (
-            round(tpu_rps, 3) if tpu_ok else None
+        "vs_baseline": (
+            round(best_rps / cpu_rps, 1) if kernel_ok and cpu_rps else None
         ),
-        "mfu_pct_per_client_path": round(mfu * 100, 1) if tpu_ok else None,
+        "mfu_pct": round(best_mfu * 100, 1) if kernel_ok else None,
+        "fedavg_rounds_per_sec_per_client_path": (
+            round(tpu_rps, 3) if kernel_ok else None
+        ),
+        "mfu_pct_per_client_path": (
+            round(mfu * 100, 1) if kernel_ok else None
+        ),
         "fedavg_rounds_per_sec_folded_path": (
-            round(tpu_rps_folded, 3) if tpu_ok else None
+            round(tpu_rps_folded, 3) if kernel_ok else None
         ),
         "mfu_pct_folded_path": (
-            round(mfu_folded * 100, 1) if tpu_ok else None
+            round(mfu_folded * 100, 1) if kernel_ok else None
         ),
-        "cpu_baseline_rounds_per_sec": round(cpu_rps, 4),
+        "cpu_baseline_rounds_per_sec": (
+            round(cpu_rps, 4) if cpu_rps else None
+        ),
         **proto,
     }
     if not tpu_ok:
