@@ -55,8 +55,13 @@ def _env_num(name: str, default, cast, allow_zero: bool = False):
             file=sys.stderr,
         )
         return default
-    floor = 0 if allow_zero else 1
-    return value if value >= floor else default
+    if value > 0 or (allow_zero and value == 0):
+        return value
+    print(
+        f"ignoring out-of-range {name}={raw!r}; using {default}",
+        file=sys.stderr,
+    )
+    return default
 
 
 PROTO_WORKERS = _env_num("PYGRID_BENCH_WORKERS", 64, int)
@@ -1127,7 +1132,10 @@ def _tpu_reachable_with_retry() -> bool:
     record to nulls is a worse failure than ~3 extra probe minutes.
     Bounded so a hard-down tunnel still leaves the watchdog plenty of
     budget for the protocol-only bench."""
-    attempts = max(1, _env_num("PYGRID_BENCH_PROBE_RETRIES", 3, int))
+    # 0 is legitimate here — "probe once, never retry" (max(1,…) below)
+    attempts = max(
+        1, _env_num("PYGRID_BENCH_PROBE_RETRIES", 3, int, allow_zero=True)
+    )
     delay = _env_num("PYGRID_BENCH_PROBE_DELAY", 45.0, float, allow_zero=True)
     # hard cap: probing may consume at most a third of the watchdog budget
     # — however the env knobs are set, the protocol-only fallback must

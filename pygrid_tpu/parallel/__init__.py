@@ -26,6 +26,11 @@ from pygrid_tpu.parallel.distributed import (  # noqa: F401
     hybrid_mesh,
     local_batch_slice,
 )
+from pygrid_tpu.parallel.fsdp import (  # noqa: F401
+    make_fsdp_training_step,
+    shard_params,
+    unshard_params,
+)
 from pygrid_tpu.parallel.secagg_sim import (  # noqa: F401
     make_sharded_masked_sum,
     mask_clients,
