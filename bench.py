@@ -78,27 +78,33 @@ def _flops_per_round() -> float:
     return 6.0 * K * BATCH * dots
 
 
-def bench_tpu() -> tuple[float, float, float, float]:
-    """Returns (rounds/sec per-client, its MFU, rounds/sec folded, its MFU).
+def bench_tpu() -> dict:
+    """FedAvg kernel-plane numbers for the four round builders.
 
-    Two kernel shapes of the same algorithm (identical outputs — the
-    identity is tested in test_fedavg_sim.py):
+    All are the same algorithm (identities tested in
+    ``test_fedavg_sim.py`` / ``test_fedavg_fused.py``):
 
-    - *per-client*: vmapped clients — the general path (local_steps > 1,
-      stateful optimizers) and, measured on chip, the FASTER one: XLA
-      fuses the mean of per-client diffs into the producers, so the
-      [K, 784, 392] diff tensor never materializes in HBM.
+    - *per-client (fused)*: the general per-client path rebuilt from the
+      model's loss with the final-step aggregation reassociated —
+      ``grad_q of the mean loss at p_k + q`` — so every layer's weight
+      grad is ONE folded matmul (``fedavg_fused.make_fused_rounds``).
+      Per-client semantics, folded-path MFU; the headline per-client
+      number.
+    - *per-client (opaque)*: vmapped opaque ``training_step`` — the path
+      any black-box plan or stateful client optimizer rides; batched
+      64-row weight-grad matmuls bound it to ~35% MFU.
     - *folded* (``fold_clients=True``): K·B samples fold into one batch
-      before the first matmul. Its big dots run at ~86% MFU in isolation,
-      but the compiled step loses ~3 ms/round to unfused elementwise/
-      softmax passes over the 65536-row activations — measured ~2.4×
-      slower end-to-end than the per-client program (BASELINE.md).
+      before the first matmul.
+    - *ls4*: the fused builder at ``local_steps=4`` with a bf16 delta
+      carry — real multi-step FL, where the [K, |params|] per-client
+      carry is algorithmically required and the round is bandwidth-bound
+      (BASELINE.md documents the roofline).
     """
     import jax
     import jax.numpy as jnp
 
     from pygrid_tpu.models import mlp
-    from pygrid_tpu.parallel import make_scanned_rounds
+    from pygrid_tpu.parallel import make_fused_rounds, make_scanned_rounds
 
     print(f"device: {jax.devices()[0]}", file=sys.stderr)
     params = mlp.init(jax.random.PRNGKey(0), SIZES)
@@ -118,6 +124,15 @@ def bench_tpu() -> tuple[float, float, float, float]:
             fold_clients=fold,
         )
 
+    def fused(n: int, local_steps: int = 1, carry_dtype=None):
+        return make_fused_rounds(
+            mlp.loss_and_acc,
+            n_rounds=n,
+            local_steps=local_steps,
+            matmul_precision="BF16_BF16_F32",
+            carry_dtype=carry_dtype,
+        )
+
     # Round-4 capture hardening. The tunneled platform adds a LARGE,
     # VARIABLE per-call overhead (measured 20-70 ms dispatch+fetch) — a
     # 10-round marginal buries ~1 ms/round of signal under ±10 ms of
@@ -127,8 +142,8 @@ def bench_tpu() -> tuple[float, float, float, float]:
     # signal; min-over-trials kills the one-sided host-load tail.
     small_n, large_n = 10, 10 + TIMED_ROUNDS
 
-    def measure(fold: bool) -> float:
-        fns = {n: scanned(n, fold) for n in (small_n, large_n)}
+    def measure(builder) -> float:
+        fns = {n: builder(n) for n in (small_n, large_n)}
         for n, fn in fns.items():  # compile both programs
             out = fn(params, client_X, client_y, lr)
             _ = float(out[1][-1])  # host fetch — on tunneled platforms
@@ -144,18 +159,36 @@ def bench_tpu() -> tuple[float, float, float, float]:
         t_large = min(run(large_n) for _ in range(6))
         return (t_large - t_small) / TIMED_ROUNDS  # marginal timing
 
-    dt_per_client = measure(fold=False)
-    dt_folded = measure(fold=True)
-    mfu_pc = _flops_per_round() / dt_per_client / (PEAK_TFLOPS * 1e12)
-    mfu_fold = _flops_per_round() / dt_folded / (PEAK_TFLOPS * 1e12)
+    dt_fused = measure(lambda n: fused(n))
+    dt_opaque = measure(lambda n: scanned(n, fold=False))
+    dt_folded = measure(lambda n: scanned(n, fold=True))
+    dt_ls4 = measure(
+        lambda n: fused(n, local_steps=4, carry_dtype=jnp.bfloat16)
+    )
+    peak = PEAK_TFLOPS * 1e12
+    mfu_fused = _flops_per_round() / dt_fused / peak
+    mfu_opaque = _flops_per_round() / dt_opaque / peak
+    mfu_fold = _flops_per_round() / dt_folded / peak
+    mfu_ls4 = 4 * _flops_per_round() / dt_ls4 / peak
     print(
-        f"tpu: per-client {dt_per_client*1e3:.2f} ms/round @ {K} clients "
-        f"({K/dt_per_client:,.0f} client-updates/sec, MFU {mfu_pc*100:.1f}%) | "
-        f"folded {dt_folded*1e3:.2f} ms/round "
-        f"(MFU {mfu_fold*100:.1f}%) of {PEAK_TFLOPS:.0f} TF bf16",
+        f"tpu: per-client[fused] {dt_fused*1e3:.2f} ms/round @ {K} clients "
+        f"({K/dt_fused:,.0f} client-updates/sec, MFU {mfu_fused*100:.1f}%) | "
+        f"opaque {dt_opaque*1e3:.2f} ms (MFU {mfu_opaque*100:.1f}%) | "
+        f"folded {dt_folded*1e3:.2f} ms (MFU {mfu_fold*100:.1f}%) | "
+        f"ls4[bf16 carry] {dt_ls4*1e3:.2f} ms (MFU {mfu_ls4*100:.1f}%) "
+        f"of {PEAK_TFLOPS:.0f} TF bf16",
         file=sys.stderr,
     )
-    return 1.0 / dt_per_client, mfu_pc, 1.0 / dt_folded, mfu_fold
+    return {
+        "per_client_rps": 1.0 / dt_fused,
+        "per_client_mfu": mfu_fused,
+        "opaque_rps": 1.0 / dt_opaque,
+        "opaque_mfu": mfu_opaque,
+        "folded_rps": 1.0 / dt_folded,
+        "folded_mfu": mfu_fold,
+        "ls4_rps": 1.0 / dt_ls4,
+        "ls4_mfu": mfu_ls4,
+    }
 
 
 def bench_cpu_torch_baseline() -> float:
@@ -1204,11 +1237,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        tpu_rps = mfu = tpu_rps_folded = mfu_folded = None
+        kernel = None
     else:
-        tpu_rps, mfu, tpu_rps_folded, mfu_folded = _guard_call(
-            "kernel", bench_tpu, proto, default=(None,) * 4
-        )
+        kernel = _guard_call("kernel", bench_tpu, proto, default=None)
     _guard("protocol_json", lambda: bench_protocol("json"), proto)
     _guard("protocol_binary", lambda: bench_protocol("binary"), proto)
     _guard("report_handler", bench_report_handler, proto)
@@ -1220,13 +1251,12 @@ def main() -> None:
         _guard("fed_transformer", bench_fed_transformer, proto)
         _guard("fed_transformer_long", bench_fed_transformer_long, proto)
     cpu_rps = _guard_call("cpu_baseline", bench_cpu_torch_baseline, proto)
-    # headline = the faster of the two identical-output kernel shapes
-    # (identity asserted in tests/unit/test_fedavg_sim.py); both reported
-    kernel_ok = tpu_ok and tpu_rps is not None
-    if kernel_ok and tpu_rps_folded > tpu_rps:
-        best_rps, best_mfu = tpu_rps_folded, mfu_folded
-    else:
-        best_rps, best_mfu = tpu_rps, mfu
+    # headline = the fastest of the identical-output kernel shapes
+    # (identities asserted in test_fedavg_sim.py / test_fedavg_fused.py)
+    kernel_ok = tpu_ok and kernel is not None
+    if kernel_ok:
+        best_rps = max(kernel["per_client_rps"], kernel["folded_rps"])
+        best_mfu = max(kernel["per_client_mfu"], kernel["folded_mfu"])
     result = {
         "metric": "fedavg_rounds_per_sec_1k_clients",
         "value": round(best_rps, 3) if kernel_ok else None,
@@ -1236,16 +1266,28 @@ def main() -> None:
         ),
         "mfu_pct": round(best_mfu * 100, 1) if kernel_ok else None,
         "fedavg_rounds_per_sec_per_client_path": (
-            round(tpu_rps, 3) if kernel_ok else None
+            round(kernel["per_client_rps"], 3) if kernel_ok else None
         ),
         "mfu_pct_per_client_path": (
-            round(mfu * 100, 1) if kernel_ok else None
+            round(kernel["per_client_mfu"] * 100, 1) if kernel_ok else None
+        ),
+        "fedavg_rounds_per_sec_per_client_opaque": (
+            round(kernel["opaque_rps"], 3) if kernel_ok else None
+        ),
+        "mfu_pct_per_client_opaque": (
+            round(kernel["opaque_mfu"] * 100, 1) if kernel_ok else None
         ),
         "fedavg_rounds_per_sec_folded_path": (
-            round(tpu_rps_folded, 3) if kernel_ok else None
+            round(kernel["folded_rps"], 3) if kernel_ok else None
         ),
         "mfu_pct_folded_path": (
-            round(mfu_folded * 100, 1) if kernel_ok else None
+            round(kernel["folded_mfu"] * 100, 1) if kernel_ok else None
+        ),
+        "fedavg_rounds_per_sec_ls4": (
+            round(kernel["ls4_rps"], 3) if kernel_ok else None
+        ),
+        "mfu_pct_ls4": (
+            round(kernel["ls4_mfu"] * 100, 1) if kernel_ok else None
         ),
         "cpu_baseline_rounds_per_sec": (
             round(cpu_rps, 4) if cpu_rps else None

@@ -10,6 +10,10 @@ from pygrid_tpu.parallel.fedavg import (  # noqa: F401
     make_sharded_round,
     run_rounds,
 )
+from pygrid_tpu.parallel.fedavg_fused import (  # noqa: F401
+    make_fused_round,
+    make_fused_rounds,
+)
 from pygrid_tpu.parallel.ring_attention import (  # noqa: F401
     attention,
     ring_attention,

@@ -1,0 +1,117 @@
+"""Fused-aggregation FedAvg rounds == the opaque per-client builder.
+
+The fused path reassociates mean-of-grads into grad-of-mean (one folded
+matmul per layer); these tests pin that reassociation to the opaque
+``training_step`` path at f32 tolerance, for one and several local
+steps, across model families.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pygrid_tpu.models import cnn, mlp
+from pygrid_tpu.parallel import (
+    make_fused_round,
+    make_fused_rounds,
+    make_scanned_rounds,
+)
+
+
+def _mnist_clients(key, n_clients, per_client, dim=64, classes=10):
+    kx, kw = jax.random.split(key)
+    X = jax.random.normal(kx, (n_clients, per_client, dim))
+    labels = jnp.argmax(
+        X.reshape(-1, dim) @ jax.random.normal(kw, (dim, classes)), -1
+    ).reshape(n_clients, per_client)
+    return X, jax.nn.one_hot(labels, classes)
+
+
+@pytest.mark.parametrize("local_steps", [1, 3])
+def test_fused_matches_opaque_mlp(local_steps):
+    params = mlp.init(jax.random.PRNGKey(0), (64, 32, 10))
+    X, y = _mnist_clients(jax.random.PRNGKey(1), n_clients=8, per_client=16)
+    lr = jnp.float32(0.2)
+
+    opaque = make_scanned_rounds(
+        mlp.training_step, n_rounds=3, local_steps=local_steps
+    )
+    fused = make_fused_rounds(
+        mlp.loss_and_acc, n_rounds=3, local_steps=local_steps
+    )
+    p1, l1, a1 = opaque(params, X, y, lr)
+    p2, l2, a2 = fused(params, X, y, lr)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        )
+    np.testing.assert_allclose(
+        np.asarray(l1), np.asarray(l2), rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(a1), np.asarray(a2), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_fused_matches_opaque_cnn():
+    """The fold is model-generic: conv weight grads reassociate too."""
+    params = cnn.init(jax.random.PRNGKey(2))
+    X = jax.random.normal(jax.random.PRNGKey(3), (4, 8, 28, 28, 1))
+    labels = jax.random.randint(jax.random.PRNGKey(4), (4, 8), 0, 10)
+    y = jax.nn.one_hot(labels, 10)
+    lr = jnp.float32(0.05)
+
+    opaque = make_scanned_rounds(cnn.training_step, n_rounds=2)
+    fused = make_fused_rounds(cnn.loss_and_acc, n_rounds=2)
+    p1, l1, _ = opaque(params, X, y, lr)
+    p2, l2, _ = fused(params, X, y, lr)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        )
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-4)
+
+
+def test_fused_round_single():
+    params = mlp.init(jax.random.PRNGKey(5), (32, 16, 4))
+    kx, kw = jax.random.split(jax.random.PRNGKey(6))
+    X = jax.random.normal(kx, (8, 8, 32))
+    labels = jnp.argmax(
+        X.reshape(-1, 32) @ jax.random.normal(kw, (32, 4)), -1
+    ).reshape(8, 8)
+    y = jax.nn.one_hot(labels, 4)
+    round_fn = make_fused_round(mlp.loss_and_acc, local_steps=2)
+    p, loss, acc = round_fn(params, X, y, jnp.float32(0.3))
+    assert jnp.isfinite(loss)
+    # it learns: a few more rounds improve accuracy
+    for _ in range(4):
+        p, loss2, acc2 = round_fn(p, X, y, jnp.float32(0.3))
+    assert float(loss2) < float(loss)
+
+
+def test_bf16_delta_carry_stays_close():
+    """carry_dtype=bf16 halves the middle-step bandwidth; the delta cast
+    must stay within bf16 resolution of the f32 path."""
+    params = mlp.init(jax.random.PRNGKey(7), (64, 32, 10))
+    X, y = _mnist_clients(jax.random.PRNGKey(8), n_clients=8, per_client=16)
+    lr = jnp.float32(0.2)
+    f32 = make_fused_rounds(mlp.loss_and_acc, n_rounds=2, local_steps=3)
+    bf16 = make_fused_rounds(
+        mlp.loss_and_acc, n_rounds=2, local_steps=3,
+        carry_dtype=jnp.bfloat16,
+    )
+    p1, l1, _ = f32(params, X, y, lr)
+    p2, l2, _ = bf16(params, X, y, lr)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-3
+        )
+    np.testing.assert_allclose(
+        np.asarray(l1), np.asarray(l2), rtol=1e-2, atol=1e-3
+    )
+
+
+def test_local_steps_validation():
+    with pytest.raises(ValueError):
+        make_fused_rounds(mlp.loss_and_acc, n_rounds=1, local_steps=0)
