@@ -1,0 +1,143 @@
+"""Transformer loss-head variants and remat policies.
+
+The chunked CE, the narrow-dtype CE backward, and the dots-saveable
+remat policy must all be the SAME model — identical losses, and grads
+identical (f32 paths) or within mixed-precision tolerance (bf16 CE
+backward). The fused FedAvg builder composed with the transformer loss
+must match the opaque training-step rounds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pygrid_tpu.models import transformer as T
+from pygrid_tpu.parallel import make_fused_rounds, make_scanned_rounds
+
+CFG = T.TransformerConfig(
+    vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64, max_len=32
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = T.init(jax.random.PRNGKey(0), CFG)
+    X = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, CFG.vocab)
+    y = jnp.roll(X, -1, axis=-1)
+    return params, X, y
+
+
+def _grads(params, X, y, **kw):
+    return jax.grad(
+        lambda p: T.loss_and_acc(p, X, y, CFG, **kw)[0]
+    )(params)
+
+
+def test_ce_chunk_matches_plain(setup):
+    params, X, y = setup
+    l1, a1 = T.loss_and_acc(params, X, y, CFG)
+    l2, a2 = T.loss_and_acc(params, X, y, CFG, ce_chunk=16)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    np.testing.assert_allclose(float(a1), float(a2), rtol=1e-6)
+    for g1, g2 in zip(_grads(params, X, y), _grads(params, X, y, ce_chunk=16)):
+        np.testing.assert_allclose(
+            np.asarray(g1), np.asarray(g2), atol=1e-6
+        )
+
+
+def test_ce_chunk_must_divide(setup):
+    params, X, y = setup
+    with pytest.raises(ValueError):
+        T.loss_and_acc(params, X, y, CFG, ce_chunk=7)
+
+
+def test_ce_chunk_and_grad_dtype_exclusive(setup):
+    params, X, y = setup
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        T.loss_and_acc(
+            params, X, y, CFG, ce_chunk=16, ce_grad_dtype="bfloat16"
+        )
+
+
+def test_ce_grad_dtype_forward_is_f32_exact(setup):
+    """With compute_dtype unset, the custom head's FORWARD must match
+    the plain f32 path bit-closely even when the backward narrows —
+    the narrow dtype may only touch gradients."""
+    params, X, y = setup
+    l1, a1 = T.loss_and_acc(params, X, y, CFG)
+    l2, a2 = T.loss_and_acc(params, X, y, CFG, ce_grad_dtype="bfloat16")
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    np.testing.assert_allclose(float(a1), float(a2), rtol=1e-6)
+
+
+def test_ce_grad_dtype_f32_exact(setup):
+    """With an f32 'narrow' dtype the custom-VJP head is exactly the
+    plain autodiff path — isolates the restructuring from the cast."""
+    params, X, y = setup
+    l1, _ = T.loss_and_acc(params, X, y, CFG)
+    l2, _ = T.loss_and_acc(params, X, y, CFG, ce_grad_dtype="float32")
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for g1, g2 in zip(
+        _grads(params, X, y), _grads(params, X, y, ce_grad_dtype="float32")
+    ):
+        np.testing.assert_allclose(
+            np.asarray(g1), np.asarray(g2), atol=1e-5
+        )
+
+
+def test_ce_grad_dtype_bf16_close(setup):
+    params, X, y = setup
+    ref = _grads(params, X, y)
+    bf = _grads(params, X, y, ce_grad_dtype="bfloat16")
+    for g1, g2 in zip(ref, bf):
+        scale = float(jnp.max(jnp.abs(g1))) + 1e-9
+        dev = float(jnp.max(jnp.abs(g1 - g2))) / scale
+        assert dev < 0.03, f"bf16 CE backward drifted {dev:.4f}"
+
+
+@pytest.mark.parametrize("remat", [True, "dots"])
+def test_remat_variants_match(setup, remat):
+    params, X, y = setup
+    l1, _ = T.loss_and_acc(params, X, y, CFG)
+    l2, _ = T.loss_and_acc(params, X, y, CFG, remat=remat)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for g1, g2 in zip(_grads(params, X, y), _grads(params, X, y, remat=remat)):
+        np.testing.assert_allclose(
+            np.asarray(g1), np.asarray(g2), atol=1e-5
+        )
+
+
+def test_fused_rounds_match_opaque_transformer(setup):
+    """The flagship bench path: fused-aggregation FedAvg over transformer
+    clients == opaque scanned rounds (f32, 1e-4)."""
+    from functools import partial
+
+    params, _, _ = setup
+    Kc = 4
+    X = jax.random.randint(
+        jax.random.PRNGKey(2), (Kc, 2, 32), 0, CFG.vocab
+    )
+    y = jnp.roll(X, -1, axis=-1)
+    lr = jnp.float32(0.05)
+
+    step = T.make_training_step(CFG)
+    loss_fn = partial(T.loss_and_acc, cfg=CFG)
+    p1, l1, a1 = make_scanned_rounds(step, n_rounds=2)(params, X, y, lr)
+    p2, l2, a2 = make_fused_rounds(loss_fn, n_rounds=2)(params, X, y, lr)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4
+        )
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-4)
+
+
+def test_features_apply_consistent(setup):
+    """apply == features @ embed.T (the split must not drift)."""
+    params, X, _ = setup
+    logits = T.apply(params, X, CFG)
+    h = T.features(params, X, CFG)
+    ref = jnp.dot(h, params[0].T, preferred_element_type=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref), atol=1e-6
+    )
