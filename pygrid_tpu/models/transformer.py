@@ -63,6 +63,13 @@ def init(key: jax.Array, cfg: TransformerConfig = TransformerConfig()) -> list[j
     return params
 
 
+def _cast(x: jax.Array, cd) -> jax.Array:
+    """The ONE compute-dtype cast policy (None = no cast) — apply,
+    features, the loss, and the custom CE head must all narrow operands
+    identically or their numerics silently diverge."""
+    return x.astype(cd) if cd is not None else x
+
+
 def _ln(x, scale, bias, eps=1e-6):
     # norm statistics always in f32 — bf16 mean/variance drifts
     x = x.astype(jnp.float32)
@@ -100,7 +107,7 @@ def apply(
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
     def c(x: jax.Array) -> jax.Array:
-        return x.astype(cd) if cd is not None else x
+        return _cast(x, cd)
 
     h = features(
         params, tokens, cfg, attn_fn, remat=remat,
@@ -130,7 +137,7 @@ def features(
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
     def c(x: jax.Array) -> jax.Array:
-        return x.astype(cd) if cd is not None else x
+        return _cast(x, cd)
 
     embed, pos = params[0], params[1]
     B, L = tokens.shape
@@ -184,7 +191,7 @@ def _ce_head(h2, embed, y1, fwd_cd, bwd_cd):
     """
 
     def cf(x):
-        return x.astype(fwd_cd) if fwd_cd is not None else x
+        return _cast(x, fwd_cd)
 
     def fwd(h2, embed, y1):
         logits = jnp.dot(
@@ -247,7 +254,7 @@ def loss_and_acc(
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
     def c(x: jax.Array) -> jax.Array:
-        return x.astype(cd) if cd is not None else x
+        return _cast(x, cd)
 
     embed = params[0]
     if ce_grad_dtype is not None:

@@ -13,6 +13,7 @@ from pygrid_tpu.parallel.fedavg import (  # noqa: F401
 from pygrid_tpu.parallel.fedavg_fused import (  # noqa: F401
     make_fused_round,
     make_fused_rounds,
+    make_sharded_fused_round,
 )
 from pygrid_tpu.parallel.ring_attention import (  # noqa: F401
     attention,

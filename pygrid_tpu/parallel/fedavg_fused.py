@@ -42,11 +42,14 @@ opaque ``training_step`` path.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
 
 
 def _sgd_steps(
@@ -94,6 +97,39 @@ def _sgd_steps(
     ]
 
 
+def _fused_grad_and_metrics(loss_fn, p_k, batched, client_X, client_y):
+    """The gradient-semantics core both builders share: the mean loss
+    over vmapped clients as a function of a shared zero offset ``q``
+    added to every client's params. Because ``q`` is unbatched under the
+    vmap, grad w.r.t. it emits each layer's weight gradient as ONE
+    folded dot over the merged client×batch rows.
+
+    ``p_k``: per-client params (leading K) when ``batched``, else the
+    shared params. Returns ``(loss, acc, grads)`` — loss/acc are the
+    client means at the pre-update point (matching the opaque path's
+    metrics), grads are the client-mean gradients."""
+
+    def mean_loss(q):
+        def per_client(p, X, y):
+            return loss_fn([pi + qi for pi, qi in zip(p, q)], X, y)
+
+        losses, accs = jax.vmap(
+            per_client, in_axes=(0 if batched else None, 0, 0)
+        )(p_k, client_X, client_y)
+        return jnp.mean(losses), jnp.mean(accs)
+
+    # zeros derived FROM p_k leaves (slice, not fresh jnp.zeros): under
+    # shard_map a fresh array is device-INVARIANT, and grads w.r.t. an
+    # invariant value get an implicit psum across the mesh — which would
+    # silently double-aggregate with the caller's explicit pmean
+    zeros = [
+        jnp.zeros_like(p[0]) if batched else jnp.zeros_like(p)
+        for p in p_k
+    ]
+    (loss, acc), g = jax.value_and_grad(mean_loss, has_aux=True)(zeros)
+    return loss, acc, g
+
+
 def make_fused_rounds(
     loss_fn: Callable,
     n_rounds: int,
@@ -124,55 +160,29 @@ def make_fused_rounds(
 
     @jax.jit
     def rounds_fn(params, client_X, client_y, lr):
-        zeros = [jnp.zeros_like(p) for p in params]
-
-        def final_step_and_agg(p_k, batched: bool):
-            """Fused last local step + FedAvg mean.
-
-            ``p_k``: per-client params (leading K) when ``batched``, else
-            the shared round-start params. Returns (new_global_params,
-            mean_loss, mean_acc) where loss/acc are evaluated at the
-            pre-update point — matching the opaque path's metrics."""
-
-            def mean_loss(q):
-                def per_client(p, X, y):
-                    return loss_fn(
-                        [pi + qi for pi, qi in zip(p, q)], X, y
-                    )
-
-                losses, accs = jax.vmap(
-                    per_client, in_axes=(0 if batched else None, 0, 0)
-                )(p_k, client_X, client_y)
-                return jnp.mean(losses), jnp.mean(accs)
-
-            (loss, acc), g = jax.value_and_grad(mean_loss, has_aux=True)(
-                zeros
-            )
-            mean_p = (
-                [jnp.mean(p, axis=0) for p in p_k] if batched else p_k
-            )
-            return (
-                [mp - lr * gi for mp, gi in zip(mean_p, g)],
-                loss,
-                acc,
-            )
-
         def one_round(p, _):
             if local_steps == 1:
-                new_p, loss, acc = final_step_and_agg(p, batched=False)
-                return new_p, (loss, acc)
+                p_k, batched = p, False
+            else:
+                # steps 1..N-1 carry true per-client params (this
+                # traffic IS the algorithm once clients diverge);
+                # optionally as a narrow-dtype delta against the shared
+                # round-start params
+                def warm(X, y):
+                    return _sgd_steps(
+                        loss_fn, p, X, y, lr, local_steps - 1,
+                        carry_dtype=carry_dtype,
+                    )
 
-            # steps 1..N-1 carry true per-client params (this traffic IS
-            # the algorithm once clients diverge); optionally as a
-            # narrow-dtype delta against the shared round-start params
-            def warm(X, y):
-                return _sgd_steps(
-                    loss_fn, p, X, y, lr, local_steps - 1,
-                    carry_dtype=carry_dtype,
-                )
+                p_k, batched = jax.vmap(warm)(client_X, client_y), True
 
-            p_k = jax.vmap(warm)(client_X, client_y)
-            new_p, loss, acc = final_step_and_agg(p_k, batched=True)
+            loss, acc, g = _fused_grad_and_metrics(
+                loss_fn, p_k, batched, client_X, client_y
+            )
+            mean_p = (
+                [jnp.mean(pk, axis=0) for pk in p_k] if batched else p_k
+            )
+            new_p = [mp - lr * gi for mp, gi in zip(mean_p, g)]
             return new_p, (loss, acc)
 
         def body():
@@ -188,6 +198,80 @@ def make_fused_rounds(
         return final, losses, accs
 
     return rounds_fn
+
+
+def make_sharded_fused_round(
+    loss_fn: Callable,
+    mesh: Mesh,
+    local_steps: int = 1,
+    axis: str = "clients",
+    carry_dtype: jnp.dtype | None = None,
+) -> Callable:
+    """Fused-aggregation FedAvg round with the client axis SHARDED.
+
+    The multi-chip shape of :func:`make_fused_rounds`: each device runs
+    its client shard's local steps and the fused final-step gradient
+    (one folded matmul per layer over the shard's ``K_local·B`` rows);
+    the cross-device aggregation is a single ``pmean`` of those
+    already-reduced gradients (plus one of the shard-mean params when
+    ``local_steps > 1``) riding ICI — O(|params|) bytes on the wire per
+    round, never O(K·|params|). Mirrors
+    :func:`fedavg.make_sharded_round`'s contract (params/lr replicated
+    in, client data sharded on its leading axis, outputs replicated);
+    equivalence against the single-device fused builder is tested on the
+    8-device CPU mesh in ``tests/unit/test_fedavg_fused.py``.
+    """
+
+    def shard_fn(params, client_X, client_y, lr):
+        # pcast keeps local training local under shard_map's
+        # replication-aware autodiff (see make_sharded_round's note)
+        params_v = [lax.pcast(p, axis, to="varying") for p in params]
+        lr_v = lax.pcast(lr, axis, to="varying")
+
+        if local_steps > 1:
+
+            def warm(X, y):
+                return _sgd_steps(
+                    loss_fn, params_v, X, y, lr_v, local_steps - 1,
+                    carry_dtype=carry_dtype,
+                )
+
+            p_k = jax.vmap(warm)(client_X, client_y)
+            batched = True
+        else:
+            p_k = params_v
+            batched = False
+
+        loss, acc, g = _fused_grad_and_metrics(
+            loss_fn, p_k, batched, client_X, client_y
+        )
+        # shard-local mean then pmean == global mean (equal shard sizes,
+        # enforced by the sharding); the final combine uses the
+        # REPLICATED params/lr — pmean outputs are device-invariant and
+        # mixing the pcast-varying lr back in would make the outputs
+        # varying, which out_specs=P() rejects
+        g = [lax.pmean(gi, axis) for gi in g]
+        if batched:
+            mean_p = [
+                lax.pmean(jnp.mean(p, axis=0), axis) for p in p_k
+            ]
+        else:
+            mean_p = params
+        new_params = [mp - lr * gi for mp, gi in zip(mean_p, g)]
+        return (
+            new_params,
+            lax.pmean(loss, axis),
+            lax.pmean(acc, axis),
+        )
+
+    repl = P()
+    sharded = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(repl, P(axis), P(axis), repl),
+        out_specs=(repl, repl, repl),
+    )
+    return jax.jit(sharded)
 
 
 def make_fused_round(

@@ -115,3 +115,31 @@ def test_bf16_delta_carry_stays_close():
 def test_local_steps_validation():
     with pytest.raises(ValueError):
         make_fused_rounds(mlp.loss_and_acc, n_rounds=1, local_steps=0)
+
+
+@pytest.mark.parametrize("local_steps", [1, 2])
+def test_sharded_fused_matches_single_device(local_steps):
+    """pmean-of-folded-grads over the mesh == the single-device fused
+    round — the multi-chip shape of the flagship per-client path."""
+    from pygrid_tpu.parallel import make_fused_round, make_mesh
+    from pygrid_tpu.parallel.fedavg_fused import make_sharded_fused_round
+
+    mesh = make_mesh(8, axes=("clients",))
+    params = mlp.init(jax.random.PRNGKey(9), (64, 32, 10))
+    X, y = _mnist_clients(
+        jax.random.PRNGKey(10), n_clients=16, per_client=8
+    )
+    lr = jnp.float32(0.2)
+
+    single = make_fused_round(mlp.loss_and_acc, local_steps=local_steps)
+    sharded = make_sharded_fused_round(
+        mlp.loss_and_acc, mesh, local_steps=local_steps
+    )
+    p1, l1, a1 = single(params, X, y, lr)
+    p2, l2, a2 = sharded(params, X, y, lr)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        )
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(float(a1), float(a2), rtol=1e-5)
