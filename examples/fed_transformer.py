@@ -5,10 +5,12 @@ MLP/CNN, SURVEY.md §5.7): K simulated clients each run local SGD on a
 decoder-only transformer — the Pallas flash-attention kernel inside
 every client step, bf16 mixed precision on TPU — and FedAvg aggregates
 the diffs, all in ONE compiled program per round
-(``parallel.make_scanned_rounds`` over ``models.transformer``). The
+(``parallel.make_fused_rounds`` over ``models.transformer`` — the
+round-5 fused-aggregation builder whose final-step weight grads fold
+into one matmul per layer, plus the bf16 CE backward on TPU). The
 same composition trains over a client-sharded device mesh in
-``__graft_entry__.dryrun_multichip`` (scenario 8) and is benchmarked on
-the real chip by ``bench.py bench_fed_transformer``.
+``__graft_entry__.dryrun_multichip`` (scenarios 8 and 9) and is
+benchmarked on the real chip by ``bench.py bench_fed_transformer``.
 
 The task is tiny on purpose (copy-class sequences): the point is the
 composition converging, not the corpus.
@@ -34,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from pygrid_tpu.models import transformer
-from pygrid_tpu.parallel import make_scanned_rounds
+from pygrid_tpu.parallel import make_fused_rounds
 from pygrid_tpu.parallel.pallas_attention import flash_attention
 
 K, B, L = 4, 4, 32          # clients × per-client batch × sequence length
@@ -46,14 +48,16 @@ def main() -> int:
     cfg = transformer.TransformerConfig(
         vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=L
     )
-    step = transformer.make_training_step(
-        cfg,
+    loss_fn = partial(
+        transformer.loss_and_acc,
+        cfg=cfg,
         # the flash kernel Mosaic-compiles on TPU; interpret mode runs the
         # same kernel on CPU
         attn_fn=partial(flash_attention, interpret=on_cpu),
-        # mixed precision earns its keep on the MXU; on CPU it just slows
-        # the interpreter down
+        # mixed precision (and the bf16 CE backward) earn their keep on
+        # the MXU; on CPU they just slow the interpreter down
         compute_dtype=None if on_cpu else "bfloat16",
+        ce_grad_dtype=None if on_cpu else "bfloat16",
     )
 
     # task: one base corpus, each client holding ITS OWN token shift of
@@ -65,7 +69,7 @@ def main() -> int:
     y = jnp.asarray(base[..., 1:])
 
     params = transformer.init(jax.random.PRNGKey(0), cfg)
-    rounds = make_scanned_rounds(step, n_rounds=ROUNDS)
+    rounds = make_fused_rounds(loss_fn, n_rounds=ROUNDS)
     final, losses, accs = rounds(params, X, y, jnp.float32(0.3))
     first, last = float(losses[0]), float(losses[-1])
     print(

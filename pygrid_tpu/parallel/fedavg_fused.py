@@ -221,6 +221,8 @@ def make_sharded_fused_round(
     equivalence against the single-device fused builder is tested on the
     8-device CPU mesh in ``tests/unit/test_fedavg_fused.py``.
     """
+    if local_steps < 1:
+        raise ValueError("local_steps must be >= 1")
 
     def shard_fn(params, client_X, client_y, lr):
         # pcast keeps local training local under shard_map's

@@ -117,10 +117,16 @@ def test_local_steps_validation():
         make_fused_rounds(mlp.loss_and_acc, n_rounds=1, local_steps=0)
 
 
-@pytest.mark.parametrize("local_steps", [1, 2])
-def test_sharded_fused_matches_single_device(local_steps):
+@pytest.mark.parametrize(
+    "local_steps,carry_dtype",
+    [(1, None), (2, None), (3, jnp.bfloat16)],
+)
+def test_sharded_fused_matches_single_device(local_steps, carry_dtype):
     """pmean-of-folded-grads over the mesh == the single-device fused
-    round — the multi-chip shape of the flagship per-client path."""
+    round — the multi-chip shape of the flagship per-client path. The
+    bf16 delta-carry case pins the device-invariance-sensitive path
+    (zeros under shard_map must stay varying or grads get an implicit
+    psum)."""
     from pygrid_tpu.parallel import make_fused_round, make_mesh
     from pygrid_tpu.parallel.fedavg_fused import make_sharded_fused_round
 
@@ -131,9 +137,13 @@ def test_sharded_fused_matches_single_device(local_steps):
     )
     lr = jnp.float32(0.2)
 
-    single = make_fused_round(mlp.loss_and_acc, local_steps=local_steps)
+    single = make_fused_round(
+        mlp.loss_and_acc, local_steps=local_steps,
+        carry_dtype=carry_dtype,
+    )
     sharded = make_sharded_fused_round(
-        mlp.loss_and_acc, mesh, local_steps=local_steps
+        mlp.loss_and_acc, mesh, local_steps=local_steps,
+        carry_dtype=carry_dtype,
     )
     p1, l1, a1 = single(params, X, y, lr)
     p2, l2, a2 = sharded(params, X, y, lr)
@@ -143,3 +153,14 @@ def test_sharded_fused_matches_single_device(local_steps):
         )
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
     np.testing.assert_allclose(float(a1), float(a2), rtol=1e-5)
+
+
+def test_sharded_local_steps_validation():
+    from pygrid_tpu.parallel import make_mesh
+    from pygrid_tpu.parallel.fedavg_fused import make_sharded_fused_round
+
+    with pytest.raises(ValueError):
+        make_sharded_fused_round(
+            mlp.loss_and_acc, make_mesh(8, axes=("clients",)),
+            local_steps=0,
+        )
