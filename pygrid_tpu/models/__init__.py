@@ -1,4 +1,4 @@
-from pygrid_tpu.models import cnn, mlp, transformer  # noqa: F401
+from pygrid_tpu.models import cnn, decode, mlp, transformer  # noqa: F401
 
 #: model family registry (name -> module with init/apply/training_step)
 REGISTRY = {"mlp": mlp, "cnn": cnn, "transformer": transformer}

@@ -1,0 +1,219 @@
+"""Autoregressive decoding for the transformer family — KV-cache serving.
+
+The training side of the flagship model lives in
+:mod:`pygrid_tpu.models.transformer`; this module is its inference twin:
+a static-shape KV cache plus a ``lax.scan``-driven ``generate`` so the
+whole decode loop is ONE compiled XLA program (no per-token Python
+dispatch, no dynamic shapes — the cache is allocated at ``max_len`` and
+masked by position, the idiom XLA/TPU wants).
+
+No reference analog: the reference's inference surface is data-centric
+``run_inference`` over MLP/CNN plans (SURVEY §2.1); autoregressive
+generation exists here because the transformer family does. The decode
+attention is a masked dense pass over the cache — at single-token decode
+the op is bandwidth-bound on the cache read and XLA's fused
+softmax(qkᵀ)v is already the right program, so no Pallas kernel is
+needed (the flash kernel earns its keep on the L×L training path).
+
+Correctness contract: greedy decode from a prompt must equal repeated
+full-forward ``transformer.apply`` argmax (teacher-forced equivalence,
+``tests/unit/test_decode.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pygrid_tpu.models.transformer import (
+    PARAMS_PER_LAYER,
+    TransformerConfig,
+    _cast,
+    _ln,
+)
+
+
+class KVCache(NamedTuple):
+    """Static-shape per-layer key/value cache.
+
+    ``k``/``v``: [n_layers, B, max_len, n_heads, head_dim]; ``pos``: the
+    number of valid positions already written (scalar int32, traced).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+
+
+def init_cache(
+    cfg: TransformerConfig,
+    batch: int,
+    dtype: Any = jnp.float32,
+) -> KVCache:
+    dh = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, batch, cfg.max_len, cfg.n_heads, dh)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        pos=jnp.int32(0),
+    )
+
+
+def _decode_attention(q, k_cache, v_cache, n_valid):
+    """Masked dense attention of ONE query position against the cache.
+
+    q: [B, H, dh]; k_cache/v_cache: [B, max_len, H, dh]; n_valid: scalar
+    count of live cache rows (the query's own k/v already written).
+    f32 softmax per the repo-wide contract."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bhd,blhd->bhl", q, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    mask = jnp.arange(k_cache.shape[1]) < n_valid  # [max_len]
+    s = jnp.where(mask[None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhl,blhd->bhd", p.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def decode_step(
+    params: Sequence[jax.Array],
+    cache: KVCache,
+    token: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    compute_dtype: Any | None = None,
+) -> tuple[jax.Array, KVCache]:
+    """One decode step: ``token`` [B] int32 at position ``cache.pos`` →
+    (logits [B, vocab] f32, cache with k/v appended)."""
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def c(x):
+        return _cast(x, cd)
+
+    embed, pos_emb = params[0], params[1]
+    B = token.shape[0]
+    dh = cfg.d_model // cfg.n_heads
+    t = cache.pos
+    h = c(embed[token] + pos_emb[t])  # [B, d]
+
+    new_k, new_v = cache.k, cache.v
+    idx = 2
+    for layer in range(cfg.n_layers):
+        (ln1_s, ln1_b, wq, wk, wv, wo, ln2_s, ln2_b, w1, b1, w2, b2) = (
+            params[idx : idx + PARAMS_PER_LAYER]
+        )
+        x = c(_ln(h, ln1_s, ln1_b))
+        q = (x @ c(wq)).reshape(B, cfg.n_heads, dh)
+        k = (x @ c(wk)).reshape(B, cfg.n_heads, dh)
+        v = (x @ c(wv)).reshape(B, cfg.n_heads, dh)
+        new_k = new_k.at[layer, :, t].set(k.astype(new_k.dtype))
+        new_v = new_v.at[layer, :, t].set(v.astype(new_v.dtype))
+        a = _decode_attention(
+            q, new_k[layer], new_v[layer], t + 1
+        ).reshape(B, cfg.d_model)
+        h = h + c(a) @ c(wo)
+        x = c(_ln(h, ln2_s, ln2_b))
+        h = h + c(jax.nn.gelu(x @ c(w1) + c(b1))) @ c(w2) + c(b2)
+        idx += PARAMS_PER_LAYER
+    h = _ln(h, params[idx], params[idx + 1])
+    logits = jnp.dot(
+        c(h), c(embed).T, preferred_element_type=jnp.float32
+    )
+    return logits, KVCache(k=new_k, v=new_v, pos=t + 1)
+
+
+def prefill(
+    params: Sequence[jax.Array],
+    cache: KVCache,
+    prompt: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    compute_dtype: Any | None = None,
+) -> tuple[jax.Array, KVCache]:
+    """Feed a [B, P] prompt token-by-token via ``lax.scan``; returns the
+    last position's logits and the filled cache. O(P·max_len) attention
+    work — fine at serving prompt sizes; the training path (flash) is
+    the tool for long-context ingestion at scale."""
+
+    def step(carry, tok_t):
+        cache, _ = carry
+        logits, cache = decode_step(
+            params, cache, tok_t, cfg, compute_dtype
+        )
+        return (cache, logits), None
+
+    B = prompt.shape[0]
+    init_logits = jnp.zeros((B, cfg.vocab), jnp.float32)
+    (cache, logits), _ = lax.scan(
+        step, (cache, init_logits), prompt.T
+    )
+    return logits, cache
+
+
+def generate(
+    params: Sequence[jax.Array],
+    prompt: jax.Array,
+    n_new: int,
+    cfg: TransformerConfig = TransformerConfig(),
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    compute_dtype: Any | None = None,
+    cache_dtype: Any | None = None,
+) -> jax.Array:
+    """Generate ``n_new`` tokens after a [B, P] prompt; returns [B, n_new].
+
+    ``temperature == 0``: greedy argmax. Otherwise softmax sampling at
+    the given temperature (``key`` required). The prefill and the decode
+    loop are each one ``lax.scan`` — the whole call jits to a single
+    XLA program with a static-shape cache. ``cache_dtype`` narrows the
+    KV cache itself (decode is bandwidth-bound on the cache read, so
+    bf16 halves the per-step sweep); defaults to ``compute_dtype`` when
+    that is set, else f32. Exactly ``n_new - 1`` decode steps run after
+    prefill — the first token comes from the prefill logits.
+    """
+    if prompt.shape[1] + n_new > cfg.max_len:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + n_new ({n_new}) exceeds "
+            f"max_len ({cfg.max_len})"
+        )
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+
+    kv_dtype = (
+        cache_dtype
+        if cache_dtype is not None
+        else (compute_dtype if compute_dtype is not None else jnp.float32)
+    )
+    cache = init_cache(cfg, prompt.shape[0], dtype=kv_dtype)
+    logits, cache = prefill(params, cache, prompt, cfg, compute_dtype)
+
+    def pick(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1
+        ).astype(prompt.dtype)
+
+    keys = (
+        jax.random.split(key, n_new)
+        if key is not None
+        else jnp.zeros((n_new, 2), jnp.uint32)
+    )
+
+    first = pick(logits, keys[0])
+
+    def step(carry, k):
+        cache, tok = carry
+        new_logits, cache = decode_step(
+            params, cache, tok, cfg, compute_dtype
+        )
+        nxt = pick(new_logits, k)
+        return (cache, nxt), nxt
+
+    _, rest = lax.scan(step, (cache, first), keys[1:])
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
