@@ -188,6 +188,33 @@ class DataCentricFLClient:
             raise PyGridError(response.get("error", "inference failed"))
         return np.asarray(response["prediction"])
 
+    def run_remote_generation(
+        self,
+        model_id: str,
+        prompt: Any,
+        n_new: int = 16,
+        temperature: float = 0.0,
+        seed: int | None = None,
+    ) -> Any:
+        """Autoregressive generation from a hosted transformer bundle
+        (``models.decode.bundle``): int prompt [B, P] → int tokens
+        [B, n_new]. Greedy at ``temperature=0``, else sampled (``seed``
+        makes the server's sampling reproducible)."""
+        payload = {
+            MSG_FIELD.MODEL_ID: model_id,
+            MSG_FIELD.DATA: base64.b64encode(
+                serialize(np.asarray(prompt))
+            ).decode(),
+            "n_new": int(n_new),
+            "temperature": float(temperature),
+        }
+        if seed is not None:
+            payload["seed"] = int(seed)
+        response = self.ws.send_json(REQUEST_MSG.RUN_GENERATION, **payload)
+        if not response.get("success"):
+            raise PyGridError(response.get("error", "generation failed"))
+        return np.asarray(response["tokens"])
+
     def delete_model(self, model_id: str) -> dict:
         return self.ws.send_json(
             REQUEST_MSG.DELETE_MODEL, **{MSG_FIELD.MODEL_ID: model_id}

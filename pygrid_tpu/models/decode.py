@@ -36,6 +36,36 @@ from pygrid_tpu.models.transformer import (
 )
 
 
+def bundle(
+    cfg: TransformerConfig, params: Sequence[jax.Array]
+) -> dict:
+    """Servable transformer bundle for ``host-model`` /
+    ``run-generation``: a plain serde-serializable dict carrying the
+    config and parameters, so a node can rebuild the model and run
+    :func:`generate` against it (``node/events.py run_generation``)."""
+    import numpy as np
+
+    return {
+        "family": "transformer",
+        "cfg": list(cfg),
+        "params": [np.asarray(p) for p in params],
+    }
+
+
+def from_bundle(spec: dict) -> tuple[TransformerConfig, list[jax.Array]]:
+    """Inverse of :func:`bundle` (validates the family tag)."""
+    if not isinstance(spec, dict) or spec.get("family") != "transformer":
+        raise ValueError("not a generative transformer bundle")
+    cfg = TransformerConfig(*[int(v) for v in spec["cfg"]])
+    params = [jnp.asarray(p) for p in spec["params"]]
+    expect = 2 + PARAMS_PER_LAYER * cfg.n_layers + 2
+    if len(params) != expect:
+        raise ValueError(
+            f"bundle has {len(params)} params, config needs {expect}"
+        )
+    return cfg, params
+
+
 class KVCache(NamedTuple):
     """Static-shape per-layer key/value cache.
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 import base64
 import binascii
 import logging
+import os
 import uuid
 from typing import Any, Callable
 
@@ -411,6 +412,65 @@ def host_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         return {SUCCESS: False, ERROR: str(err)}
 
 
+def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """Autoregressive generation from a hosted transformer bundle
+    (``models/decode.py``) — the serving twin of ``run_inference`` for
+    the generative model family. Message fields: ``model_id``, ``data``
+    (serialized int prompt [B, P]), ``n_new``, optional ``temperature``
+    + ``seed``. Gated by the same ``allow_remote_inference`` flag. No
+    reference analog (its inference surface is feed-forward only)."""
+    _authenticated(conn)
+    import numpy as np
+
+    try:
+        got = _servable_and_data(ctx, message)
+        if isinstance(got, dict):
+            return got
+        hosted, prompt = got
+        from pygrid_tpu.models import decode
+
+        cfg, params = decode.from_bundle(hosted.model)
+        prompt = np.asarray(prompt)
+        if (
+            prompt.ndim != 2
+            or prompt.shape[1] < 1
+            or not np.issubdtype(prompt.dtype, np.integer)
+        ):
+            return {
+                SUCCESS: False,
+                ERROR: "prompt must be non-empty int tokens [B, P]",
+            }
+        if prompt.min() < 0 or prompt.max() >= cfg.vocab:
+            return {
+                SUCCESS: False,
+                ERROR: f"prompt token out of range [0, {cfg.vocab})",
+            }
+        n_new = int(message.get("n_new", 16))
+        if n_new < 1:
+            return {SUCCESS: False, ERROR: "n_new must be >= 1"}
+        temperature = float(message.get("temperature", 0.0))
+        seed = message.get("seed")
+
+        import jax
+        import jax.numpy as jnp
+
+        if temperature > 0.0 and seed is None:
+            # unseeded sampling must actually vary across requests
+            seed = int.from_bytes(os.urandom(4), "big")
+        key = jax.random.PRNGKey(int(seed)) if seed is not None else None
+        toks = decode.generate(
+            params,
+            jnp.asarray(prompt),
+            n_new,
+            cfg,
+            temperature=temperature,
+            key=key,
+        )
+        return {SUCCESS: True, "tokens": np.asarray(toks).tolist()}
+    except (E.PyGridError, ValueError, TypeError) as err:
+        return {SUCCESS: False, ERROR: str(err)}
+
+
 def delete_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     _authenticated(conn)
     try:
@@ -424,6 +484,30 @@ def get_models(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     return {MSG_FIELD.MODELS: ctx.models.models(ctx.local_worker.id)}
 
 
+#: shared by run_inference / run_generation: both routes gate on the
+#: same allow_remote_inference flag and accept the same base64-or-bytes
+#: serialized data field
+_NOT_ALLOWED = {
+    SUCCESS: False,
+    "not_allowed": True,
+    ERROR: "You're not allowed to run inferences on this model.",
+}
+
+
+def _servable_and_data(ctx: NodeContext, message: dict):
+    """(hosted_model, deserialized_data) for an inference-family route,
+    or an error-response dict when the permission gate rejects."""
+    if len(ctx.local_worker.store) == 0:
+        recover_objects(ctx.local_worker, ctx.kv)
+    hosted = ctx.models.get(ctx.local_worker.id, message[MSG_FIELD.MODEL_ID])
+    if not hosted.allow_remote_inference:
+        return dict(_NOT_ALLOWED)
+    blob = message[MSG_FIELD.DATA]
+    if isinstance(blob, str):
+        blob = base64.b64decode(blob)
+    return hosted, deserialize(bytes(blob))
+
+
 def run_inference(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     """(reference model_events.py:77-129) run a hosted model on submitted
     data; predictions return as a plain list."""
@@ -431,19 +515,10 @@ def run_inference(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     import numpy as np
 
     try:
-        if len(ctx.local_worker.store) == 0:
-            recover_objects(ctx.local_worker, ctx.kv)
-        hosted = ctx.models.get(ctx.local_worker.id, message[MSG_FIELD.MODEL_ID])
-        if not hosted.allow_remote_inference:
-            return {
-                SUCCESS: False,
-                "not_allowed": True,
-                ERROR: "You're not allowed to run inferences on this model.",
-            }
-        blob = message[MSG_FIELD.DATA]
-        if isinstance(blob, str):
-            blob = base64.b64decode(blob)
-        data = deserialize(bytes(blob))
+        got = _servable_and_data(ctx, message)
+        if isinstance(got, dict):
+            return got
+        hosted, data = got
         output = hosted.model(data)
         if isinstance(output, (tuple, list)):
             output = output[0]
@@ -476,6 +551,7 @@ ROUTES: dict[str, Callable[[NodeContext, dict, Connection], dict]] = {
     REQUEST_MSG.CONNECT_NODE: connect_grid_nodes,
     REQUEST_MSG.HOST_MODEL: host_model,
     REQUEST_MSG.RUN_INFERENCE: run_inference,
+    REQUEST_MSG.RUN_GENERATION: run_generation,
     REQUEST_MSG.DELETE_MODEL: delete_model,
     REQUEST_MSG.LIST_MODELS: get_models,
     REQUEST_MSG.AUTHENTICATE: authentication,

@@ -122,6 +122,11 @@ class REQUEST_MSG:
     CONNECT_NODE = "connect-node"
     HOST_MODEL = "host-model"
     RUN_INFERENCE = "run-inference"
+    #: autoregressive generation from a hosted transformer bundle — no
+    #: reference analog (the reference's inference surface stops at
+    #: feed-forward run-inference); exists because the transformer model
+    #: family does (models/decode.py)
+    RUN_GENERATION = "run-generation"
     DELETE_MODEL = "delete-model"
     LIST_MODELS = "list-models"
     AUTHENTICATE = "authentication"
