@@ -80,6 +80,18 @@ def main() -> int:
     if not last < first - 0.3:
         print("loss did not improve", file=sys.stderr)
         return 1
+
+    # serve what you trained: KV-cache greedy decoding from the
+    # federated params (models/decode.py; over a grid this same call
+    # runs server-side via client.run_remote_generation)
+    from pygrid_tpu.models import decode
+
+    prompt = X[0, :1, :8]  # first 8 tokens of client 0's shard
+    toks = decode.generate(final, prompt, 12, cfg)
+    print(
+        f"generated continuation of {list(map(int, prompt[0]))}: "
+        f"{list(map(int, toks[0]))}"
+    )
     return 0
 
 

@@ -412,6 +412,37 @@ def host_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         return {SUCCESS: False, ERROR: str(err)}
 
 
+#: memoized jitted decode programs, keyed on everything trace-relevant
+#: ((cfg ints, n_new, temperature, seeded) — params/prompt shapes key
+#: jit's own cache); bounded so hostile n_new variety can't grow it
+#: without limit
+_GENERATION_JIT: dict = {}
+
+
+def _generation_fn(cfg, n_new: int, temperature: float, seeded: bool):
+    key = (tuple(cfg), n_new, temperature, seeded)
+    fn = _GENERATION_JIT.get(key)
+    if fn is None:
+        import jax
+
+        from pygrid_tpu.models import decode
+
+        if len(_GENERATION_JIT) >= 64:
+            _GENERATION_JIT.clear()
+        if seeded:
+            fn = jax.jit(
+                lambda p, x, k: decode.generate(
+                    p, x, n_new, cfg, temperature=temperature, key=k
+                )
+            )
+        else:
+            fn = jax.jit(
+                lambda p, x: decode.generate(p, x, n_new, cfg)
+            )
+        _GENERATION_JIT[key] = fn
+    return fn
+
+
 def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     """Autoregressive generation from a hosted transformer bundle
     (``models/decode.py``) — the serving twin of ``run_inference`` for
@@ -429,10 +460,18 @@ def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         hosted, prompt = got
         from pygrid_tpu.models import decode
 
-        cfg, params = decode.from_bundle(hosted.model)
+        # parse + device-upload the bundle ONCE per hosted model (the
+        # HostedModel lives in the process-wide ModelCache, so every
+        # later request reuses the on-device params)
+        cached = getattr(hosted, "_generation", None)
+        if cached is None:
+            cached = decode.from_bundle(hosted.model)
+            hosted._generation = cached
+        cfg, params = cached
         prompt = np.asarray(prompt)
         if (
             prompt.ndim != 2
+            or prompt.shape[0] < 1
             or prompt.shape[1] < 1
             or not np.issubdtype(prompt.dtype, np.integer)
         ):
@@ -449,6 +488,9 @@ def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         if n_new < 1:
             return {SUCCESS: False, ERROR: "n_new must be >= 1"}
         temperature = float(message.get("temperature", 0.0))
+        # `== 0 or > 0` rejects both negatives AND NaN (NaN fails both)
+        if not (temperature == 0.0 or temperature > 0.0):
+            return {SUCCESS: False, ERROR: "temperature must be >= 0"}
         seed = message.get("seed")
 
         import jax
@@ -457,15 +499,11 @@ def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         if temperature > 0.0 and seed is None:
             # unseeded sampling must actually vary across requests
             seed = int.from_bytes(os.urandom(4), "big")
-        key = jax.random.PRNGKey(int(seed)) if seed is not None else None
-        toks = decode.generate(
-            params,
-            jnp.asarray(prompt),
-            n_new,
-            cfg,
-            temperature=temperature,
-            key=key,
-        )
+        fn = _generation_fn(cfg, n_new, temperature, seed is not None)
+        if seed is not None:
+            toks = fn(params, jnp.asarray(prompt), jax.random.PRNGKey(int(seed)))
+        else:
+            toks = fn(params, jnp.asarray(prompt))
         return {SUCCESS: True, "tokens": np.asarray(toks).tolist()}
     except (E.PyGridError, ValueError, TypeError) as err:
         return {SUCCESS: False, ERROR: str(err)}

@@ -218,6 +218,7 @@ def test_remote_generation_validates_inputs(alice):
     for bad_prompt, pattern in (
         (np.ones((1, 3), np.float32), "int tokens"),       # float dtype
         (np.zeros((1, 0), np.int32), "int tokens"),        # empty prompt
+        (np.zeros((0, 3), np.int32), "int tokens"),        # empty batch
         (np.array([1, 2], np.int32), "int tokens"),        # wrong ndim
         (np.array([[1, 99]], np.int32), "out of range"),   # vocab overflow
         (np.array([[-1, 2]], np.int32), "out of range"),   # negative token
@@ -233,6 +234,11 @@ def test_remote_generation_validates_inputs(alice):
     with pytest.raises(PyGridError, match="n_new"):
         alice.run_remote_generation(
             "validate-gen-model", np.array([[1, 2]], np.int32), n_new=0
+        )
+    with pytest.raises(PyGridError, match="temperature"):
+        alice.run_remote_generation(
+            "validate-gen-model", np.array([[1, 2]], np.int32), n_new=2,
+            temperature=-0.5,
         )
 
 
