@@ -391,8 +391,30 @@ def bench_attention() -> dict:
         t_hi = min(run(hi) for _ in range(trials))
         return (t_hi - t_lo) / (hi - lo)
 
-    t_flash = marginal(functools.partial(flash_attention, causal=True))
-    t_xla = marginal(functools.partial(attention, causal=True))
+    # physicality floors: a marginal below FLOPs/peak means the
+    # chip-state drift hit the two chain lengths differently (fast-state
+    # hi chain vs slow-state lo chain under-measures the slope) —
+    # re-measure rather than record an impossible >peak number. The
+    # flash kernel prunes the causal upper triangle (2·B·H·L²·D); the
+    # dense path executes the full masked L×L matmuls (4·B·H·L²·D).
+    flash_floor_s = 2.0 * B * H * L * L * D / (PEAK_TFLOPS * 1e12)
+    dense_floor_s = 2.0 * flash_floor_s
+
+    def physical_marginal(fn, floor_s, attempts=3):
+        ts = []
+        for _ in range(attempts):
+            t = marginal(fn)
+            ts.append(t)
+            if t >= floor_s:
+                return t
+        return max(ts)  # closest to physical of the failed attempts
+
+    t_flash = physical_marginal(
+        functools.partial(flash_attention, causal=True), flash_floor_s
+    )
+    t_xla = physical_marginal(
+        functools.partial(attention, causal=True), dense_floor_s
+    )
     print(
         f"attention[causal L={L} H={H} D={D} bf16]: "
         f"flash {t_flash*1e3:.3f} ms vs xla {t_xla*1e3:.3f} ms "
@@ -823,13 +845,14 @@ def bench_fed_transformer_long() -> dict:
     L=8192 with the Pallas flash kernels in BOTH directions (the XLA
     dense path cannot even materialize the L=8192 scores).
 
-    The headline ``fed_transformer_long_{L}_*`` runs WITHOUT block remat:
-    flash attention's O(L·block) footprint means these shapes fit HBM
-    with activations stored — remat would re-pay ~⅓ of the forward FLOPs
-    for memory that is not scarce. The ``*_remat_*`` twins keep the
-    rematerialized path measured (it is what even longer contexts or
-    bigger batches must ride), so both points of the memory/FLOPs trade
-    stay driver-captured."""
+    The headline ``fed_transformer_long_{4096,8192}_*`` keys run WITHOUT
+    block remat: flash attention's O(L·block) footprint means those
+    shapes fit HBM with activations stored — remat would re-pay ~⅓ of
+    the forward FLOPs for memory that is not scarce. Their ``*_remat_*``
+    twins keep the rematerialized path measured. The ``_32768_`` key IS
+    a remat run (at that length remat is the deployment config — see the
+    loop comment), so the three headline L values are not config-uniform
+    by design."""
     from pygrid_tpu.models import transformer
 
     out: dict = {}

@@ -32,6 +32,10 @@ class HostedModel:
     allow_remote_inference: bool = False
     mpc: bool = False
     serialized: bytes | None = field(default=None, repr=False)
+    #: per-process memo of the parsed generative bundle — (cfg, device
+    #: params) — filled by node.events.run_generation on first use so
+    #: later requests skip re-parsing + host→device upload
+    generation_cache: Any = field(default=None, repr=False, compare=False)
 
     def flags(self) -> dict[str, Any]:
         return {

@@ -190,7 +190,7 @@ def generate(
     prompt: jax.Array,
     n_new: int,
     cfg: TransformerConfig = TransformerConfig(),
-    temperature: float = 0.0,
+    temperature: float | jax.Array = 0.0,
     key: jax.Array | None = None,
     compute_dtype: Any | None = None,
     cache_dtype: Any | None = None,
@@ -198,21 +198,28 @@ def generate(
     """Generate ``n_new`` tokens after a [B, P] prompt; returns [B, n_new].
 
     ``temperature == 0``: greedy argmax. Otherwise softmax sampling at
-    the given temperature (``key`` required). The prefill and the decode
-    loop are each one ``lax.scan`` — the whole call jits to a single
-    XLA program with a static-shape cache. ``cache_dtype`` narrows the
-    KV cache itself (decode is bandwidth-bound on the cache read, so
-    bf16 halves the per-step sweep); defaults to ``compute_dtype`` when
-    that is set, else f32. Exactly ``n_new - 1`` decode steps run after
-    prefill — the first token comes from the prefill logits.
+    the given temperature (``key`` required); ``temperature`` may be a
+    traced scalar when sampling, so one jitted program serves every
+    temperature. The prefill and the decode loop are each one
+    ``lax.scan`` — the whole call jits to a single XLA program with a
+    static-shape cache. ``cache_dtype`` narrows the KV cache itself
+    (decode is bandwidth-bound on the cache read, so bf16 halves the
+    per-step sweep); defaults to ``compute_dtype`` when that is set,
+    else f32. Exactly ``n_new - 1`` decode steps run after prefill —
+    the first token comes from the prefill logits.
     """
     if prompt.shape[1] + n_new > cfg.max_len:
         raise ValueError(
             f"prompt ({prompt.shape[1]}) + n_new ({n_new}) exceeds "
             f"max_len ({cfg.max_len})"
         )
-    if temperature > 0.0 and key is None:
+    temp_is_static = isinstance(temperature, (int, float))
+    if temp_is_static and temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if not temp_is_static and key is None:
+        raise ValueError("a traced temperature requires a PRNG key")
+    # sample iff a key was provided and temperature isn't a static zero
+    greedy = key is None or (temp_is_static and temperature == 0.0)
 
     kv_dtype = (
         cache_dtype
@@ -223,7 +230,7 @@ def generate(
     logits, cache = prefill(params, cache, prompt, cfg, compute_dtype)
 
     def pick(logits, k):
-        if temperature == 0.0:
+        if greedy:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
         return jax.random.categorical(
             k, logits / temperature, axis=-1

@@ -413,15 +413,16 @@ def host_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
 
 
 #: memoized jitted decode programs, keyed on everything trace-relevant
-#: ((cfg ints, n_new, temperature, seeded) — params/prompt shapes key
-#: jit's own cache); bounded so hostile n_new variety can't grow it
-#: without limit
+#: ((cfg ints, n_new, seeded) — temperature is a TRACED argument in the
+#: sampled program, so one compile serves every temperature;
+#: params/prompt shapes key jit's own cache); bounded so hostile n_new
+#: variety can't grow it without limit
 _GENERATION_JIT: dict = {}
 
 
-def _generation_fn(cfg, n_new: int, temperature: float, seeded: bool):
-    key = (tuple(cfg), n_new, temperature, seeded)
-    fn = _GENERATION_JIT.get(key)
+def _generation_fn(cfg, n_new: int, seeded: bool):
+    cache_key = (tuple(cfg), n_new, seeded)
+    fn = _GENERATION_JIT.get(cache_key)
     if fn is None:
         import jax
 
@@ -431,15 +432,15 @@ def _generation_fn(cfg, n_new: int, temperature: float, seeded: bool):
             _GENERATION_JIT.clear()
         if seeded:
             fn = jax.jit(
-                lambda p, x, k: decode.generate(
-                    p, x, n_new, cfg, temperature=temperature, key=k
+                lambda p, x, k, temp: decode.generate(
+                    p, x, n_new, cfg, temperature=temp, key=k
                 )
             )
         else:
             fn = jax.jit(
                 lambda p, x: decode.generate(p, x, n_new, cfg)
             )
-        _GENERATION_JIT[key] = fn
+        _GENERATION_JIT[cache_key] = fn
     return fn
 
 
@@ -463,11 +464,9 @@ def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         # parse + device-upload the bundle ONCE per hosted model (the
         # HostedModel lives in the process-wide ModelCache, so every
         # later request reuses the on-device params)
-        cached = getattr(hosted, "_generation", None)
-        if cached is None:
-            cached = decode.from_bundle(hosted.model)
-            hosted._generation = cached
-        cfg, params = cached
+        if hosted.generation_cache is None:
+            hosted.generation_cache = decode.from_bundle(hosted.model)
+        cfg, params = hosted.generation_cache
         prompt = np.asarray(prompt)
         if (
             prompt.ndim != 2
@@ -499,9 +498,15 @@ def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         if temperature > 0.0 and seed is None:
             # unseeded sampling must actually vary across requests
             seed = int.from_bytes(os.urandom(4), "big")
-        fn = _generation_fn(cfg, n_new, temperature, seed is not None)
-        if seed is not None:
-            toks = fn(params, jnp.asarray(prompt), jax.random.PRNGKey(int(seed)))
+        sampled = temperature > 0.0
+        fn = _generation_fn(cfg, n_new, sampled)
+        if sampled:
+            toks = fn(
+                params,
+                jnp.asarray(prompt),
+                jax.random.PRNGKey(int(seed)),
+                jnp.float32(temperature),
+            )
         else:
             toks = fn(params, jnp.asarray(prompt))
         return {SUCCESS: True, "tokens": np.asarray(toks).tolist()}
