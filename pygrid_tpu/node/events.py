@@ -412,6 +412,11 @@ def host_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         return {SUCCESS: False, ERROR: str(err)}
 
 
+#: KV-cache allocation cap for run-generation (elements, k+v combined):
+#: 2^28 ≈ 268M elements = 1 GB at f32 — generous for serving, far below
+#: what would OOM the node's chip/host from one hostile frame
+_MAX_GENERATION_CACHE_ELEMENTS = 1 << 28
+
 #: memoized jitted decode programs, keyed on everything trace-relevant
 #: ((cfg ints, n_new, seeded) — temperature is a TRACED argument in the
 #: sampled program, so one compile serves every temperature;
@@ -478,6 +483,23 @@ def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
                 SUCCESS: False,
                 ERROR: "prompt must be non-empty int tokens [B, P]",
             }
+        # bound what the untrusted B actually sizes — the KV cache is
+        # 2 × [layers, B, max_len, H, dh] (B is the only request-
+        # controlled factor; the rest is the hosted config), so the cap
+        # is on total cache elements, mirroring the MAX_OPLIST_ELEMENTS
+        # posture in plans/translators.py
+        cache_elems = (
+            2 * cfg.n_layers * prompt.shape[0] * cfg.max_len * cfg.d_model
+        )
+        if cache_elems > _MAX_GENERATION_CACHE_ELEMENTS:
+            return {
+                SUCCESS: False,
+                ERROR: (
+                    f"prompt batch of {prompt.shape[0]} would need a "
+                    f"{cache_elems:,}-element KV cache (cap "
+                    f"{_MAX_GENERATION_CACHE_ELEMENTS:,})"
+                ),
+            }
         if prompt.min() < 0 or prompt.max() >= cfg.vocab:
             return {
                 SUCCESS: False,
@@ -539,7 +561,12 @@ _NOT_ALLOWED = {
 
 def _servable_and_data(ctx: NodeContext, message: dict):
     """(hosted_model, deserialized_data) for an inference-family route,
-    or an error-response dict when the permission gate rejects."""
+    or an error-response dict when the permission gate rejects. Missing
+    fields raise typed PyGridErrors so the caller's error contract
+    (every defect -> {success: False, error: ...}) holds."""
+    for field_name in (MSG_FIELD.MODEL_ID, MSG_FIELD.DATA):
+        if field_name not in message:
+            raise E.PyGridError(f"missing required field '{field_name}'")
     if len(ctx.local_worker.store) == 0:
         recover_objects(ctx.local_worker, ctx.kv)
     hosted = ctx.models.get(ctx.local_worker.id, message[MSG_FIELD.MODEL_ID])

@@ -375,3 +375,98 @@ def test_oplist_concatenate_fanout_bounded():
     )
     with pytest.raises(PlanTranslationError, match="allocation bound"):
         run_oplist(evil, backend="numpy")
+
+
+# ── run-generation: hostile payloads against the generative endpoint ───────
+
+
+def test_hostile_generation_payloads_bounce_typed(ctx):
+    """Every malformed run-generation frame yields a typed error — no
+    unhandled exception, no unbounded cache/batch allocation — and the
+    endpoint still serves a good request afterwards."""
+    from types import SimpleNamespace
+
+    from pygrid_tpu.models import decode as dec
+    from pygrid_tpu.models import transformer as tf
+
+    conn = Connection(ctx, socket=object())
+    conn.session = SimpleNamespace(worker=None)  # DC login stand-in
+
+    cfg = tf.TransformerConfig(
+        vocab=19, d_model=8, n_heads=1, n_layers=1, d_ff=16, max_len=8
+    )
+    params = tf.init(jax.random.PRNGKey(31), cfg)
+    hosted = json.loads(route_requests(ctx, json.dumps({
+        "type": "host-model",
+        "model_id": "fuzz-gen",
+        "model": base64.b64encode(
+            serialize(dec.bundle(cfg, params))
+        ).decode(),
+        "allow_remote_inference": "True",
+    }), conn))
+    assert hosted.get("success"), hosted
+
+    def gen(**fields):
+        msg = {"type": "run-generation", "model_id": "fuzz-gen", **fields}
+        return json.loads(route_requests(ctx, json.dumps(msg), conn))
+
+    good_prompt = base64.b64encode(
+        serialize(np.array([[1, 2]], np.int32))
+    ).decode()
+    hostile = [
+        dict(data="!!!not-base64!!!", n_new=2),
+        dict(data=base64.b64encode(b"not serde").decode(), n_new=2),
+        dict(data=good_prompt, n_new="abc"),
+        dict(data=good_prompt, n_new=10**9),          # > max_len
+        dict(data=good_prompt, n_new=2, temperature="hot"),
+        dict(data=good_prompt, n_new=2, temperature=-1.0),
+        dict(data=good_prompt, n_new=2, temperature=float("nan")),
+        dict(data=good_prompt, n_new=2, temperature=0.5, seed="x"),
+        dict(data=base64.b64encode(serialize(
+            np.array([[1.5, 2.5]], np.float32)
+        )).decode(), n_new=2),                         # float prompt
+        dict(n_new=2),                                 # no data at all
+    ]
+    for fields in hostile:
+        out = gen(**fields)
+        payload = out.get("data", out)
+        # a TYPED handler frame (success: False), not a blanket
+        # protocol-boundary conversion of an escaped exception
+        assert isinstance(payload, dict) and payload.get(
+            "success"
+        ) is False and "error" in payload, (fields, out)
+
+    # KV-cache allocation cap: a long-context hosted config makes a
+    # modest batch size an enormous cache — one hostile frame must not
+    # size an unbounded allocation
+    big_cfg = tf.TransformerConfig(
+        vocab=19, d_model=64, n_heads=1, n_layers=4, d_ff=16,
+        max_len=8192,
+    )
+    big = json.loads(route_requests(ctx, json.dumps({
+        "type": "host-model",
+        "model_id": "fuzz-gen-big",
+        "model": base64.b64encode(serialize(
+            dec.bundle(big_cfg, tf.init(jax.random.PRNGKey(32), big_cfg))
+        )).decode(),
+        "allow_remote_inference": "True",
+    }), conn))
+    assert big.get("success"), big
+    out = json.loads(route_requests(ctx, json.dumps({
+        "type": "run-generation", "model_id": "fuzz-gen-big",
+        "data": base64.b64encode(serialize(
+            np.ones((65, 2), np.int32)
+        )).decode(),
+        "n_new": 2,
+    }), conn))
+    payload = out.get("data", out)
+    assert payload.get("success") is False and "KV cache" in payload["error"], out
+
+    # endpoint still healthy: a valid request succeeds and matches local
+    out = gen(data=good_prompt, n_new=3)
+    payload = out.get("data", out)
+    assert payload.get("success"), out
+    local = np.asarray(
+        dec.generate(params, np.array([[1, 2]], np.int32), 3, cfg)
+    )
+    np.testing.assert_array_equal(np.asarray(payload["tokens"]), local)
