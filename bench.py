@@ -1091,6 +1091,173 @@ def _bench_data_centric_impl() -> dict:
     return out
 
 
+#: wire-bench shape sets — MNIST-MLP (the protocol bench's checkpoint) and
+#: a transformer-family checkpoint (embedding + per-layer attn/mlp/ln)
+_WIRE_MODELS = {
+    "mlp": [(784, 392), (392,), (392, 10), (10,)],
+    "transformer": (
+        [(8192, 256), (256,)]
+        + [
+            s
+            for _ in range(4)
+            for s in (
+                (256, 768), (768,), (256, 256), (256,),
+                (256, 1024), (1024,), (1024, 256), (256,),
+                (256,), (256,),
+            )
+        ]
+        + [(256, 8192)]
+    ),
+}
+
+#: tiny stand-ins for CI: same structure, ~1000× fewer elements, so the
+#: smoke test exercises every encode path in milliseconds
+_WIRE_MODELS_TINY = {
+    "mlp": [(24, 12), (12,), (12, 4), (4,)],
+    "transformer": [(64, 16), (16,), (16, 48), (48,), (16, 64), (64, 16)],
+}
+
+
+def bench_wire(tiny: bool = False) -> dict:
+    """Wire-layer capture for the model/diff hot loop: bytes per
+    model-download + diff-upload round trip and p50 encode/decode latency,
+    legacy hex-in-JSON framing (the reference contract — fl_events.py
+    hexlifies every payload) vs the negotiated binary v2 path, plus the
+    composed bf16 and frame-codec variants. Pure serialization — no
+    sockets — so the numbers isolate the wire encodings themselves; the
+    protocol benches above carry the rest of the stack.
+
+    Also asserts the structural wins: binary decode of the checkpoint
+    must make ZERO tensor-buffer copies (the read-only-view contract),
+    tracked via the serde copy-count hook."""
+    import binascii
+
+    import numpy as np
+
+    from pygrid_tpu.plans.state import serialize_model_params
+    from pygrid_tpu.serde import (
+        available_codecs,
+        decode_frame,
+        deserialize,
+        encode_frame,
+        serialize,
+        tensor_copy_count,
+    )
+
+    rng = np.random.default_rng(0)
+    repeats = 5 if tiny else 15
+    out: dict = {"wire_codecs_available": list(available_codecs())}
+
+    def _p50_ms(fn) -> float:
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return round(sorted(times)[len(times) // 2] * 1e3, 3)
+
+    models = _WIRE_MODELS_TINY if tiny else _WIRE_MODELS
+    for name, shapes in models.items():
+        params = [
+            rng.standard_normal(s).astype(np.float32) for s in shapes
+        ]
+        diffs = [0.01 * p for p in params]
+        model_blob = serialize_model_params(params)
+        diff_blob = serialize_model_params(diffs)
+        head = {"worker_id": "w" * 36, "request_key": "k" * 64}
+
+        # ── legacy: hex payloads inside JSON text frames ────────────────
+        def _legacy_frames() -> tuple[str, str]:
+            down = json.dumps({
+                "type": "model-centric/get-model",
+                "data": {**head, "model": binascii.hexlify(model_blob).decode()},
+            })
+            up = json.dumps({
+                "type": "model-centric/report",
+                "data": {**head, "diff": binascii.hexlify(diff_blob).decode()},
+            })
+            return down, up
+
+        down_legacy, up_legacy = _legacy_frames()
+        bytes_legacy = len(down_legacy.encode()) + len(up_legacy.encode())
+
+        # ── v2: raw msgpack binary frames (tag byte, no envelope) ───────
+        def _v2_frames(mb: bytes, db: bytes, codec=None) -> tuple[bytes, bytes]:
+            down = encode_frame(serialize({
+                "type": "model-centric/get-model",
+                "data": {**head, "model": mb},
+            }), codec)
+            up = encode_frame(serialize({
+                "type": "model-centric/report",
+                "data": {**head, "diff": db},
+            }), codec)
+            return down, up
+
+        down_v2, up_v2 = _v2_frames(model_blob, diff_blob)
+        bytes_v2 = len(down_v2) + len(up_v2)
+
+        model_bf16 = serialize_model_params(params, bf16=True)
+        diff_bf16 = serialize_model_params(diffs, bf16=True)
+        d16, u16 = _v2_frames(model_bf16, diff_bf16)
+        bytes_bf16 = len(d16) + len(u16)
+
+        codec = available_codecs()[0]
+        dz, uz = _v2_frames(model_bf16, diff_bf16, codec)
+        bytes_bf16_z = len(dz) + len(uz)
+
+        # ── latency: p50 encode / decode per framing ────────────────────
+        enc_legacy = _p50_ms(_legacy_frames)
+        enc_v2 = _p50_ms(lambda: _v2_frames(model_blob, diff_blob))
+
+        def _decode_legacy() -> None:
+            msg = json.loads(down_legacy)
+            deserialize(binascii.unhexlify(msg["data"]["model"]))
+
+        def _decode_v2() -> None:
+            msg = deserialize(decode_frame(down_v2))
+            deserialize(msg["data"]["model"])
+
+        dec_legacy = _p50_ms(_decode_legacy)
+        dec_v2 = _p50_ms(_decode_v2)
+
+        # ── structural: checkpoint decode must be zero-copy ─────────────
+        copies_before = tensor_copy_count()
+        decoded = deserialize(model_blob)
+        copies = tensor_copy_count() - copies_before
+        assert np.array_equal(decoded.tensors()[0], params[0])
+        # enforced at FULL checkpoint scale too, not only in the tiny CI
+        # twin — a copy path that only alignment/size triggers must fail
+        # the capture (the guarded section records it), not silently land
+        # a nonzero count in the BENCH file
+        assert copies == 0, f"{name}: {copies} tensor-buffer copies on decode"
+
+        out.update({
+            f"wire_{name}_param_bytes": sum(p.nbytes for p in params),
+            f"wire_{name}_roundtrip_bytes_legacy_hex_json": bytes_legacy,
+            f"wire_{name}_roundtrip_bytes_v2": bytes_v2,
+            f"wire_{name}_roundtrip_bytes_v2_bf16": bytes_bf16,
+            f"wire_{name}_roundtrip_bytes_v2_bf16_{codec}": bytes_bf16_z,
+            f"wire_{name}_bytes_ratio": round(bytes_legacy / bytes_v2, 2),
+            f"wire_{name}_bytes_ratio_bf16": round(
+                bytes_legacy / bytes_bf16, 2
+            ),
+            f"wire_{name}_encode_ms_legacy": enc_legacy,
+            f"wire_{name}_encode_ms_v2": enc_v2,
+            f"wire_{name}_decode_ms_legacy": dec_legacy,
+            f"wire_{name}_decode_ms_v2": dec_v2,
+            f"wire_{name}_decode_tensor_copies": copies,
+        })
+        print(
+            f"wire[{name}]: {bytes_legacy/1e6:.2f} MB/round hex-JSON → "
+            f"{bytes_v2/1e6:.2f} MB v2 ({bytes_legacy/bytes_v2:.2f}x), "
+            f"{bytes_bf16/1e6:.2f} MB bf16, "
+            f"decode {dec_legacy:.2f} → {dec_v2:.2f} ms p50, "
+            f"{copies} tensor copies",
+            file=sys.stderr,
+        )
+    return out
+
+
 def bench_report_handler() -> dict:
     """Isolated node-side report-handler latency (no sockets, no client
     threads): p50 ``route_requests`` time for a protocol-realistic report
@@ -1363,6 +1530,7 @@ def main() -> None:
         kernel = None
     else:
         kernel = _guard_call("kernel", bench_tpu, proto, default=None)
+    _guard("wire", bench_wire, proto)
     _guard("protocol_json", lambda: bench_protocol("json"), proto)
     _guard("protocol_binary", lambda: bench_protocol("binary"), proto)
     _guard("report_handler", bench_report_handler, proto)

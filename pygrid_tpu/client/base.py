@@ -25,7 +25,13 @@ _SPLICE_UNSAFE = re.compile(rb'["\\\x00-\x1f\x7f-\xff]')
 
 
 class GridWSClient:
-    def __init__(self, address: str, timeout: float = 30.0) -> None:
+    def __init__(
+        self,
+        address: str,
+        timeout: float = 30.0,
+        offer_wire_v2: bool = False,
+        codec: str | None = None,
+    ) -> None:
         self.address = address.rstrip("/")
         ws_url = self.address
         for scheme, ws_scheme in (("https", "wss"), ("http", "ws")):
@@ -34,23 +40,51 @@ class GridWSClient:
                 break
         self.ws_url = ws_url
         self.timeout = timeout
+        #: offer the wire-v2 subprotocol (and ``codec``: None / "auto" /
+        #: a codec name) at connect; whether the server took it is
+        #: ``self.wire_v2`` / ``self.wire_codec`` after the handshake
+        self.offer_wire_v2 = offer_wire_v2
+        self.codec = codec
+        self.wire_v2 = False
+        self.wire_codec: str | None = None
         self._ws = None
-        self._lock = threading.Lock()
+        # reentrant: connect() locks on its own (callers may probe
+        # negotiation state before any request) and is also reached from
+        # inside already-locked request paths
+        self._lock = threading.RLock()
         self._req_prefix = uuid.uuid4().hex[:8]
         self._req_seq = 0
 
     # ── connection ──────────────────────────────────────────────────────────
 
     def connect(self) -> "GridWSClient":
+        with self._lock:
+            return self._connect_locked()
+
+    def _connect_locked(self) -> "GridWSClient":
         if self._ws is None:
             # no permessage-deflate: grid payloads are serde/base64 bytes
             # (high entropy), where zlib costs ~40x the loopback wire time
             # per MB and saves nothing — measured 128 ms vs 3.4 ms for a
-            # 1.66MB report frame. Frames mask through the native XOR
-            # kernel (the analog of the reference's masking patch,
-            # util.py:5-24).
+            # 1.66MB report frame. (Wire-v2 frame compression is per-frame
+            # and opt-in, kept only when it wins — a different trade.)
+            # Frames mask through the native XOR kernel (the analog of the
+            # reference's masking patch, util.py:5-24).
+            offers: tuple[str, ...] = ()
+            if self.offer_wire_v2:
+                from pygrid_tpu.serde import offered_subprotocols
+
+                offers = tuple(offered_subprotocols(self.codec))
             self._ws = RawWSClient(
-                self.ws_url, open_timeout=self.timeout, max_size=2**28
+                self.ws_url,
+                open_timeout=self.timeout,
+                max_size=2**28,
+                subprotocols=offers,
+            )
+            from pygrid_tpu.serde import subprotocol_codec
+
+            self.wire_v2, self.wire_codec = subprotocol_codec(
+                self._ws.subprotocol
             )
         return self
 
@@ -189,11 +223,28 @@ class GridWSClient:
     def send_msg_binary(self, msg_type: str, data: Any = None, **top_level) -> dict:
         """One msgpack-framed event round-trip — the binary twin of
         :meth:`send_json`. Payload bytes (e.g. FL diffs) travel raw: no
-        base64 inflation, no megabyte JSON parse on either side."""
-        from pygrid_tpu.serde import deserialize, serialize
+        base64 inflation, no megabyte JSON parse on either side. On a
+        wire-v2 connection frames carry the codec tag (and compress when
+        negotiated + worthwhile); otherwise bare msgpack, which any node
+        of this framework accepts."""
+        from pygrid_tpu.serde import (
+            decode_frame,
+            deserialize,
+            encode_frame,
+            serialize,
+        )
+
+        # framing is checked at call time (under _request's lock, after
+        # connect) — negotiation state doesn't exist before the handshake
+        def encode(msg: Any) -> bytes:
+            blob = serialize(msg)
+            return encode_frame(blob, self.wire_codec) if self.wire_v2 else blob
+
+        def decode(frame: bytes) -> Any:
+            return deserialize(decode_frame(frame) if self.wire_v2 else frame)
 
         return self._request(
-            msg_type, data, top_level, serialize, deserialize, want_bytes=True
+            msg_type, data, top_level, encode, decode, want_bytes=True
         )
 
     def send_binary(self, blob: bytes) -> bytes:
@@ -201,10 +252,17 @@ class GridWSClient:
         with self._lock:
             self.connect()
             try:
-                self._ws.send(blob)
+                if self.wire_v2:
+                    from pygrid_tpu.serde import decode_frame, encode_frame
+
+                    self._ws.send(encode_frame(blob, self.wire_codec))
+                else:
+                    self._ws.send(blob)
                 while True:
                     frame = self._ws.recv(timeout=self.timeout)
                     if isinstance(frame, bytes):
+                        if self.wire_v2:
+                            return bytes(decode_frame(frame))
                         return frame
             except (ConnectionError, TimeoutError, OSError):
                 self._drop_connection()

@@ -167,7 +167,15 @@ class FLClient:
     """``wire="json"`` speaks the reference's base64-in-JSON contract
     (syft.js-era clients pin it); ``wire="binary"`` speaks the msgpack twin
     — raw diff bytes, bf16 payload floats — for clients built against this
-    framework. Same events, same node, one semantic."""
+    framework. ``wire="auto"`` (the default) OFFERS the wire-v2 binary
+    subprotocol at the websocket handshake and transparently falls back to
+    the JSON contract when the node doesn't take it — new nodes get the
+    fast path, old nodes keep working, no configuration. Same events, same
+    node, one semantic.
+
+    ``codec`` rides the same handshake: "auto" offers every compression
+    codec this build has (zstd when installed, zlib always), a name forces
+    one, None disables frame compression."""
 
     def __init__(
         self,
@@ -175,15 +183,36 @@ class FLClient:
         auth_token: str | None = None,
         verbose: bool = False,
         timeout: float = 60.0,
-        wire: str = "json",
+        wire: str = "auto",
+        codec: str | None = None,
     ) -> None:
-        if wire not in ("json", "binary"):
-            raise ValueError("wire must be 'json' or 'binary'")
-        self.ws = GridWSClient(url, timeout=timeout)
+        if wire not in ("json", "binary", "auto"):
+            raise ValueError("wire must be 'json', 'binary' or 'auto'")
+        if codec not in (None, "auto"):
+            from pygrid_tpu.serde import available_codecs
+
+            # a forced codec this build can't DECODE would fail on the
+            # first download — reject at construction on every wire mode
+            # (the WS offer path only validates when it actually offers)
+            if codec not in available_codecs():
+                raise ValueError(
+                    f"codec {codec!r} not available "
+                    f"(have {available_codecs()})"
+                )
+        # a json-pinned client must be wire-identical to a v1 build: it
+        # never offers the subprotocol (the negotiation tests rely on this
+        # to impersonate old clients)
+        self.ws = GridWSClient(
+            url,
+            timeout=timeout,
+            offer_wire_v2=wire != "json",
+            codec=codec,
+        )
         self.address = self.ws.address
         self.auth_token = auth_token
         self.verbose = verbose
         self.wire = wire
+        self.codec = codec
         # plans are immutable per id once hosted (PlanManager stores the
         # variants at host time), so refetching across cycles is pure waste
         self._plan_cache: dict[tuple[int, str], Any] = {}
@@ -200,8 +229,19 @@ class FLClient:
     def new_job(self, model_name: str, model_version: str | None = None) -> FLJob:
         return FLJob(self, model_name, model_version)
 
-    def _send_event(self, msg_type: str, data: dict) -> dict:
+    def _binary_framing(self) -> bool:
+        """Whether events go out as msgpack frames. "binary" always;
+        "auto" only once the handshake negotiated wire v2 (connecting to
+        decide is exactly the point of the handshake); "json" never."""
         if self.wire == "binary":
+            return True
+        if self.wire == "auto":
+            self.ws.connect()
+            return self.ws.wire_v2
+        return False
+
+    def _send_event(self, msg_type: str, data: dict) -> dict:
+        if self._binary_framing():
             return self.ws.send_msg_binary(msg_type, data=data)
         return self.ws.send_json(msg_type, data=data)
 
@@ -269,7 +309,37 @@ class FLClient:
     ) -> list:
         """Download the current checkpoint. ``precision="bf16"`` asks the
         node to re-encode float32 params as bfloat16 on the way out — half
-        the download, the dtype client training runs in on TPU anyway."""
+        the download, the dtype client training runs in on TPU anyway.
+
+        On a negotiated wire-v2 connection the checkpoint rides the SAME
+        websocket as the rest of the cycle (raw msgpack bytes, frame
+        compression per the handshake) — no second TCP connection, no
+        base64. Otherwise: keep-alive HTTP, optionally asking the node for
+        a compressed body via ``?codec=`` (detected by response header, so
+        an old node that ignores the param still interoperates)."""
+        if self._binary_framing() and self.ws.wire_v2:
+            response = self._send_event(
+                MODEL_CENTRIC_FL_EVENTS.GET_MODEL,
+                data={
+                    MSG_FIELD.WORKER_ID: worker_id,
+                    CYCLE.KEY: request_key,
+                    MSG_FIELD.MODEL_ID: model_id,
+                    **({"precision": precision} if precision else {}),
+                },
+            )
+            data = response.get(MSG_FIELD.DATA, response)
+            if data.get("error"):
+                raise PyGridError(data["error"])
+            blob = data[MSG_FIELD.MODEL]
+            if isinstance(blob, str):  # JSON framing fallback: base64
+                blob = base64.b64decode(blob)
+            if data.get("model_wire") == "v2-frame":
+                # the node served the checkpoint pre-compressed from its
+                # per-checkpoint blob cache — one frame envelope to unwrap
+                from pygrid_tpu.serde import decode_frame
+
+                return unserialize_model_params(decode_frame(bytes(blob)))
+            return unserialize_model_params(bytes(blob))
         params = {
             "worker_id": worker_id,
             "request_key": request_key,
@@ -277,9 +347,20 @@ class FLClient:
         }
         if precision:
             params["precision"] = precision
+        if self.codec:
+            from pygrid_tpu.serde import available_codecs
+
+            want = (
+                available_codecs()[0] if self.codec == "auto" else self.codec
+            )
+            params["codec"] = want
         status, body = self._http.get("/model-centric/get-model", params)
         if status != 200:
             raise PyGridError(body.decode(errors="replace"))
+        if self._http.last_headers.get("x-pygrid-wire") == "v2-frame":
+            from pygrid_tpu.serde import decode_frame
+
+            body = decode_frame(body)
         return unserialize_model_params(body)
 
     def get_plan(
@@ -334,7 +415,7 @@ class FLClient:
         return response.get(MSG_FIELD.DATA, response)
 
     def report(self, worker_id: str, request_key: str, diff_blob: bytes) -> dict:
-        if self.wire == "binary":
+        if self._binary_framing():
             response = self._send_event(
                 MODEL_CENTRIC_FL_EVENTS.REPORT,
                 data={

@@ -63,6 +63,10 @@ class KeepAliveHTTP:
         self._timeout = timeout
         self._http = http.client
         self._conn = None
+        #: lower-cased headers of the most recent response — how callers
+        #: detect opt-in encodings the server actually applied (e.g. the
+        #: wire-v2 frame envelope on compressed checkpoint downloads)
+        self.last_headers: dict[str, str] = {}
 
     def _connect(self):
         if self._https:
@@ -85,6 +89,9 @@ class KeepAliveHTTP:
                 self._conn.request("GET", path)
                 resp = self._conn.getresponse()
                 body = resp.read()
+                self.last_headers = {
+                    k.lower(): v for k, v in resp.getheaders()
+                }
                 return resp.status, body
             except (OSError, self._http.HTTPException):
                 # stale keep-alive (server closed between cycles) — one
@@ -116,6 +123,7 @@ class RawWSClient:
         url: str,
         open_timeout: float = 30.0,
         max_size: int = 2 ** 28,
+        subprotocols: list[str] | tuple[str, ...] = (),
     ) -> None:
         parsed = urlparse(url)
         if parsed.scheme not in ("ws", "wss"):
@@ -126,6 +134,11 @@ class RawWSClient:
         if parsed.query:
             self.path += "?" + parsed.query
         self.max_size = max_size
+        self.subprotocols = tuple(subprotocols)
+        #: the server-selected subprotocol (RFC 6455 §1.9) — None when the
+        #: server ignored the offer (a pre-subprotocol node): the caller's
+        #: cue to stay on legacy framing
+        self.subprotocol: str | None = None
         self._sock = socket.create_connection(
             (self.host, self.port), timeout=open_timeout
         )
@@ -141,12 +154,18 @@ class RawWSClient:
 
     def _handshake(self, timeout: float) -> None:
         key = base64.b64encode(os.urandom(16)).decode()
+        proto_header = (
+            f"Sec-WebSocket-Protocol: {', '.join(self.subprotocols)}\r\n"
+            if self.subprotocols
+            else ""
+        )
         request = (
             f"GET {self.path} HTTP/1.1\r\n"
             f"Host: {self.host}:{self.port}\r\n"
             "Upgrade: websocket\r\n"
             "Connection: Upgrade\r\n"
             f"Sec-WebSocket-Key: {key}\r\n"
+            f"{proto_header}"
             "Sec-WebSocket-Version: 13\r\n"
             "\r\n"
         )
@@ -155,18 +174,26 @@ class RawWSClient:
         if b" 101 " not in status:
             raise ConnectionError(f"websocket handshake refused: {status!r}")
         accept = None
+        selected = None
         while True:
             line = self._rfile.readline(8192)
             if line in (b"\r\n", b"\n", b""):
                 break
             name, _, value = line.partition(b":")
-            if name.strip().lower() == b"sec-websocket-accept":
+            header = name.strip().lower()
+            if header == b"sec-websocket-accept":
                 accept = value.strip().decode()
+            elif header == b"sec-websocket-protocol":
+                selected = value.strip().decode()
         expected = base64.b64encode(
             hashlib.sha1((key + _WS_MAGIC).encode()).digest()
         ).decode()
         if accept != expected:
             raise ConnectionError("websocket handshake: bad accept key")
+        # a selection we never offered is a protocol violation — treat it
+        # as no negotiation rather than trusting the server's framing claim
+        if selected in self.subprotocols:
+            self.subprotocol = selected
 
     # ── send ─────────────────────────────────────────────────────────────────
 

@@ -948,8 +948,24 @@ class CycleManager:
                 )
                 if acc is None or acc.count != n_received:
                     acc = _DiffAccumulator()
+                    expected = self._model_shapes(process.id)
                     for d in self._received_diffs(cycle.id):
-                        acc.add(_decode(d))
+                        # restart-recovery rebuild rides the same raw-view
+                        # fold as live ingest: stored dense blobs
+                        # accumulate straight from their wire buffers (no
+                        # array materialization); DP re-clip and sparse
+                        # envelopes take the full decode door
+                        raws = None if dp else state_raw_tensors(d)
+                        if (
+                            raws is not None
+                            and all(
+                                rt.kind in ("<f4", "bf16") for rt in raws
+                            )
+                            and [rt.shape for rt in raws] == expected
+                        ):
+                            acc.add_raw(raws)
+                        else:
+                            acc.add(_decode(d))
                 n_diffs = acc.count  # the mean's actual divisor — a late
                 # racing report must scale the noise it is averaged under
                 avg_diff = acc.mean()

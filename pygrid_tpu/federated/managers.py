@@ -221,12 +221,15 @@ class ModelManager:
     def __init__(self, db: Database) -> None:
         self._models = Warehouse(S.Model, db)
         self._checkpoints = Warehouse(S.ModelCheckPoint, db)
-        #: (model_id, precision) -> (checkpoint_id, wire blob) — per model,
-        #: so concurrently-served processes don't evict each other; the
-        #: hot download path skips the sqlite megabyte row read entirely.
+        #: (model_id, checkpoint_id, precision, codec) -> wire blob. Keyed
+        #: by CHECKPOINT id, so a publish structurally invalidates — the
+        #: new round's downloads miss to the new key and can never serve
+        #: the previous round's bytes. K workers per cycle hit the same
+        #: key: the checkpoint serializes/re-encodes/compresses once per
+        #: round, not K times, and the sqlite megabyte row read is skipped.
         #: Lock: downloads run on executor threads while aggregation saves
         #: from the task thread — unsynchronized eviction would race.
-        self._blob_cache: dict[tuple[int, str], tuple[int, bytes]] = {}
+        self._blob_cache: dict[tuple[int, int, str, str], bytes] = {}
         self._blob_lock = threading.Lock()
         self._latest_ckpt: dict[int, int] = {}
         self._model_row_cache: dict[tuple, S.Model] = {}
@@ -252,15 +255,23 @@ class ModelManager:
 
     def save(self, model_id: int, blob: bytes) -> S.ModelCheckPoint:
         """New checkpoint; re-aliases "latest" (reference
-        model_manager.py:30-50)."""
+        model_manager.py:30-50). Publishing moves ``_latest_ckpt`` — the
+        blob cache is keyed by checkpoint id, so every previous round's
+        entries go stale-by-key; they're dropped eagerly here rather than
+        waiting out the LRU."""
         self._checkpoints.modify({"model_id": model_id, "alias": "latest"}, {"alias": ""})
         number = self._checkpoints.count(model_id=model_id) + 1
         ckpt = self._checkpoints.register(
             value=blob, model_id=model_id, number=number, alias="latest"
         )
-        self._latest_ckpt[model_id] = ckpt.id
-        self._cache_put((model_id, "f32"), (ckpt.id, blob))
-        self._blob_cache.pop((model_id, "bf16"), None)
+        with self._blob_lock:
+            self._latest_ckpt[model_id] = ckpt.id
+            for key in [
+                k for k in self._blob_cache
+                if k[0] == model_id and k[1] != ckpt.id
+            ]:
+                self._blob_cache.pop(key, None)
+        self._cache_put((model_id, ckpt.id, "f32", "raw"), blob)
         return ckpt
 
     def load(self, **filters: Any) -> S.ModelCheckPoint:
@@ -285,28 +296,46 @@ class ModelManager:
         hot-path rule (_model_shapes' docstring)."""
         return self._checkpoints.count(model_id=model_id)
 
-    def load_encoded(self, model_id: int, precision: str | None = None) -> bytes:
-        """Latest checkpoint blob, optionally re-encoded bf16 for the wire
-        (half the download bytes). Checkpoints are immutable per id, so
-        every worker in a cycle downloads the same bytes — the blob (and
-        its bf16 re-encoding) is read/computed once per checkpoint, not
-        per worker: at K workers per cycle the sqlite megabyte read would
-        otherwise repeat K times."""
-        # normalize: anything that isn't the bf16 re-encode serves the
-        # stored f32 blob — an attacker-varied query string must not mint
-        # unbounded cache keys
+    def load_encoded(
+        self,
+        model_id: int,
+        precision: str | None = None,
+        codec: str | None = None,
+    ) -> bytes:
+        """Latest checkpoint blob re-encoded for the wire: ``precision=
+        "bf16"`` halves the bytes; ``codec`` ("zlib"/"zstd", when this
+        build has it) serves a compressed blob for peers that negotiated
+        it. Checkpoints are immutable per id, so every worker in a cycle
+        downloads the same bytes — each (checkpoint, encoding) variant is
+        read/computed ONCE per round, not once per worker: at K workers
+        per cycle the sqlite megabyte read (and the re-encode/compress
+        pass) would otherwise repeat K times."""
+        # normalize: unknown values serve the stored f32/raw blob — an
+        # attacker-varied query string must not mint unbounded cache keys
         precision = "bf16" if precision == "bf16" else "f32"
-        key = (model_id, precision)
+        from pygrid_tpu.serde import available_codecs
+
+        codec = codec if codec in available_codecs() else "raw"
         with self._blob_lock:
             latest = self._latest_ckpt.get(model_id)
-            entry = self._blob_cache.get(key)
-            if latest is not None and entry is not None and entry[0] == latest:
-                # refresh recency: eviction must hit cold keys, not this one
-                self._blob_cache.pop(key)
-                self._blob_cache[key] = entry
-                return entry[1]
+            if latest is not None:
+                key = (model_id, latest, precision, codec)
+                blob = self._blob_cache.get(key)
+                if blob is not None:
+                    # refresh recency: eviction must hit cold keys first
+                    self._blob_cache.pop(key)
+                    self._blob_cache[key] = blob
+                    return blob
         ckpt = self.load(model_id=model_id)
-        self._latest_ckpt[model_id] = ckpt.id
+        with self._blob_lock:
+            cur = self._latest_ckpt.get(model_id)
+            if cur is None or ckpt.id > cur:
+                # never roll the pointer back: a save() racing this load
+                # may already have published a newer checkpoint, and the
+                # cache must not re-serve the older round's bytes as
+                # "latest" (checkpoint ids are monotonically increasing)
+                self._latest_ckpt[model_id] = ckpt.id
+        blob = ckpt.value
         if precision == "bf16":
             from pygrid_tpu.plans.state import (
                 serialize_model_params,
@@ -314,22 +343,28 @@ class ModelManager:
             )
 
             blob = serialize_model_params(
-                unserialize_model_params(ckpt.value), bf16=True
+                unserialize_model_params(blob), bf16=True
             )
-        else:
-            blob = ckpt.value
-        self._cache_put(key, (ckpt.id, blob))
+        if codec != "raw":
+            from pygrid_tpu.serde.wire import encode_frame
+
+            # the frame envelope (tag byte + codec stream) is exactly what
+            # a v2 peer unwraps with decode_frame — HTTP and WS downloads
+            # share the one compressed representation
+            blob = encode_frame(blob, codec)
+        self._cache_put((model_id, ckpt.id, precision, codec), blob)
         return blob
 
-    #: at most this many cached wire blobs (f32+bf16 per actively-served
-    #: model); beyond it the oldest entry evicts — a node that hosted many
-    #: finished processes must not keep their blobs resident forever
+    #: at most this many cached wire blobs (precision × codec variants per
+    #: actively-served model); beyond it the oldest entry evicts — a node
+    #: that hosted many finished processes must not keep their blobs
+    #: resident forever
     BLOB_CACHE_MAX = 16
 
-    def _cache_put(self, key: tuple, entry: tuple) -> None:
+    def _cache_put(self, key: tuple, blob: bytes) -> None:
         with self._blob_lock:
             self._blob_cache.pop(key, None)
-            self._blob_cache[key] = entry  # dict order = recency (LRU)
+            self._blob_cache[key] = blob  # dict order = recency (LRU)
             while len(self._blob_cache) > self.BLOB_CACHE_MAX:
                 oldest = next(iter(self._blob_cache), None)
                 if oldest is None:

@@ -113,6 +113,10 @@ def xor_mask_inplace(
 def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
     """float32 → bfloat16 bit pattern (uint16), round-to-nearest-even."""
     src = np.ascontiguousarray(arr, dtype=np.float32)
+    if src.ctypes.data % src.itemsize:
+        # zero-copy wire views sit at arbitrary byte offsets and
+        # ascontiguousarray does NOT realign — same hazard as bf16_to_f32
+        src = src.copy()
     out = np.empty(src.shape, dtype=np.uint16)
     if _lib is not None and src.size:
         _lib.pg_f32_to_bf16(
@@ -127,6 +131,10 @@ def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
 def bf16_to_f32(arr: np.ndarray) -> np.ndarray:
     """bfloat16 bit pattern (uint16) → float32 (exact)."""
     src = np.ascontiguousarray(arr, dtype=np.uint16)
+    if src.ctypes.data % src.itemsize:
+        # wire views can sit at any byte offset and ascontiguousarray does
+        # NOT realign — same unaligned-pointer hazard as accum_f32
+        src = src.copy()
     out = np.empty(src.shape, dtype=np.float32)
     if _lib is not None and src.size:
         _lib.pg_bf16_to_f32(

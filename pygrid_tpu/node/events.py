@@ -48,6 +48,20 @@ class Connection:
         self.socket = socket
         self.session = None  # UserSession after `authentication`
         self.worker_id: str | None = None
+        #: wire-v2 negotiation result (set by the WS endpoint after the
+        #: subprotocol handshake); False/None on legacy connections and
+        #: HTTP-route synthetic connections
+        self.wire_v2: bool = False
+        self.wire_codec: str | None = None
+        #: True while dispatching a binary (msgpack) frame — handlers that
+        #: return raw payload bytes (get-model) use it to pick base64 for
+        #: the JSON framing
+        self.binary_frame: bool = False
+        #: one-shot hint from a handler to the WS endpoint: the response
+        #: already embeds a pre-compressed payload (the per-checkpoint
+        #: blob cache), so the per-frame codec pass would be K-per-round
+        #: wasted work — skip it for THIS response only
+        self.suppress_frame_codec: bool = False
 
     @property
     def worker(self):
@@ -196,6 +210,50 @@ def cycle_request(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         response[ERROR] = str(err)
     return {
         MSG_FIELD.TYPE: MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST,
+        MSG_FIELD.DATA: response,
+    }
+
+
+def get_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """WS twin of GET /model-centric/get-model: request-key-gated download
+    of the current checkpoint, served from the ModelManager's per-
+    checkpoint wire-blob cache (serialized once per round, not once per
+    worker). Over binary framing the blob travels as raw bytes; over JSON
+    it goes out base64 (JSON cannot carry bytes)."""
+    data = message.get(MSG_FIELD.DATA) or {}
+    response: dict[str, Any] = {}
+    try:
+        model_id = int(data.get(MSG_FIELD.MODEL_ID))
+        model = ctx.fl.model_manager.get(id=model_id)
+        cycle = ctx.fl.cycle_manager.last(model.fl_process_id)
+        worker = ctx.fl.worker_manager.get(id=data.get(MSG_FIELD.WORKER_ID))
+        ctx.fl.cycle_manager.validate(
+            worker.id, cycle.id, data.get(CYCLE.KEY)
+        )
+        if conn.binary_frame and conn.wire_codec:
+            # serve the checkpoint as a pre-compressed v2 frame straight
+            # from the per-checkpoint blob cache — compressed once per
+            # round, not once per worker — and tell the WS endpoint not
+            # to re-compress the envelope around it
+            blob = ctx.fl.model_manager.load_encoded(
+                model_id,
+                precision=data.get("precision"),
+                codec=conn.wire_codec,
+            )
+            response["model_wire"] = "v2-frame"
+            conn.suppress_frame_codec = True
+        else:
+            blob = ctx.fl.model_manager.load_encoded(
+                model_id, precision=data.get("precision")
+            )
+        response[CYCLE.STATUS] = SUCCESS
+        response[MSG_FIELD.MODEL] = (
+            blob if conn.binary_frame else base64.b64encode(blob).decode()
+        )
+    except Exception as err:  # noqa: BLE001 — protocol boundary
+        response[ERROR] = str(err)
+    return {
+        MSG_FIELD.TYPE: MODEL_CENTRIC_FL_EVENTS.GET_MODEL,
         MSG_FIELD.DATA: response,
     }
 
@@ -396,10 +454,24 @@ def host_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     try:
         serialized = message[MSG_FIELD.MODEL]
         if isinstance(serialized, str):
-            serialized = base64.b64decode(serialized)
+            # native single-pass decode straight into the stored buffer —
+            # the old base64.b64decode → bytes(...) round trip copied the
+            # megabyte model twice
+            from pygrid_tpu.native import b64_decode
+
+            try:
+                serialized = b64_decode(serialized)
+            except ValueError:
+                # line-wrapped / whitespace-laced base64 (MIME tooling,
+                # encodebytes) decoded under the old permissive path and
+                # must keep working — the strict kernel is the fast path,
+                # not a contract change
+                serialized = base64.b64decode(serialized)
+        elif not isinstance(serialized, bytes):
+            serialized = bytes(serialized)
         return ctx.models.save(
             ctx.local_worker.id,
-            bytes(serialized),
+            serialized,
             message[MSG_FIELD.MODEL_ID],
             allow_download=str(message.get(MSG_FIELD.ALLOW_DOWNLOAD)) == "True",
             allow_remote_inference=str(
@@ -610,6 +682,7 @@ ROUTES: dict[str, Callable[[NodeContext, dict, Connection], dict]] = {
     MODEL_CENTRIC_FL_EVENTS.HOST_FL_TRAINING: host_federated_training,
     MODEL_CENTRIC_FL_EVENTS.AUTHENTICATE: authenticate,
     MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST: cycle_request,
+    MODEL_CENTRIC_FL_EVENTS.GET_MODEL: get_model,
     MODEL_CENTRIC_FL_EVENTS.REPORT: report,
     MODEL_CENTRIC_FL_EVENTS.REPORT_METRICS: report_metrics,
     MODEL_CENTRIC_FL_EVENTS.SECAGG_ADVERTISE: secagg_advertise,
@@ -647,21 +720,25 @@ def route_requests(
     back in either framing."""
     import json
 
-    if isinstance(message, (bytes, bytearray)):
+    if isinstance(message, (bytes, bytearray, memoryview)):
+        conn.binary_frame = True
         try:
-            parsed = deserialize(message)
-        except Exception:  # noqa: BLE001 — let the worker frame the error
-            return forward_binary_message(ctx, message, conn)
-        if isinstance(parsed, dict) and parsed.get(MSG_FIELD.TYPE) in ROUTES:
-            request_id = parsed.get(MSG_FIELD.REQUEST_ID)
             try:
-                response = ROUTES[parsed[MSG_FIELD.TYPE]](ctx, parsed, conn)
-            except Exception as err:  # noqa: BLE001 — protocol boundary
-                response = {ERROR: str(err)}
-            if request_id:
-                response[MSG_FIELD.REQUEST_ID] = request_id
-            return serialize(response)
-        return forward_binary_message(ctx, message, conn, decoded=parsed)
+                parsed = deserialize(message)
+            except Exception:  # noqa: BLE001 — let the worker frame the error
+                return forward_binary_message(ctx, message, conn)
+            if isinstance(parsed, dict) and parsed.get(MSG_FIELD.TYPE) in ROUTES:
+                request_id = parsed.get(MSG_FIELD.REQUEST_ID)
+                try:
+                    response = ROUTES[parsed[MSG_FIELD.TYPE]](ctx, parsed, conn)
+                except Exception as err:  # noqa: BLE001 — protocol boundary
+                    response = {ERROR: str(err)}
+                if request_id:
+                    response[MSG_FIELD.REQUEST_ID] = request_id
+                return serialize(response)
+            return forward_binary_message(ctx, message, conn, decoded=parsed)
+        finally:
+            conn.binary_frame = False
 
     request_id = None
     try:
@@ -673,4 +750,14 @@ def route_requests(
         response = {ERROR: str(err)}
     if request_id:
         response[MSG_FIELD.REQUEST_ID] = request_id
-    return json.dumps(response)
+    return json.dumps(response, default=_json_bytes)
+
+
+def _json_bytes(obj: Any) -> str:
+    """JSON framing of handler responses that carry payload bytes (the
+    handlers base64 for JSON themselves via ``conn.binary_frame``; this
+    default is the safety net so a bytes leak degrades to base64 text
+    instead of a 500)."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return base64.b64encode(bytes(obj)).decode()
+    raise TypeError(f"not JSON serializable: {type(obj)!r}")

@@ -161,11 +161,23 @@ async def mc_get_model(request: web.Request) -> web.Response:
         model_id = int(_require_query(request, "model_id")[0])
         model = ctx.fl.model_manager.get(id=model_id)
         _validated_cycle(ctx, request, model.fl_process_id)
+        # ?codec=zlib|zstd → the wire-v2 frame envelope, compressed once
+        # per checkpoint (blob cache) and unwrapped client-side with
+        # decode_frame. The response header is the client's only signal —
+        # an old node ignores the param and serves raw, so absence of the
+        # header means raw bytes.
+        from pygrid_tpu.serde import available_codecs
+
+        codec = request.query.get("codec")
+        codec = codec if codec in available_codecs() else None
         blob = ctx.fl.model_manager.load_encoded(
-            model_id, precision=request.query.get("precision")
+            model_id, precision=request.query.get("precision"), codec=codec
         )
+        headers = {"X-PyGrid-Wire": "v2-frame"} if codec else {}
         return web.Response(
-            body=blob, content_type="application/octet-stream"
+            body=blob,
+            content_type="application/octet-stream",
+            headers=headers,
         )
     except Exception as err:  # noqa: BLE001 — HTTP boundary
         return _json_error(err, _status_for(err))

@@ -7,6 +7,13 @@ the blocking handler work pushed to the default executor so jax/sqlite calls
 never stall the event loop. The reference's numpy XOR-masking fast path
 (``util.py:5-24``) corresponds to the native masking extension in
 ``pygrid_tpu/native`` (aiohttp itself masks frames in C already).
+
+Wire v2: clients may offer the ``pygrid.wire.v2`` websocket subprotocol
+(optionally ``+zstd``/``+zlib``) during the upgrade. On a negotiated
+connection every binary frame carries a one-byte codec tag and may be
+compressed; TEXT frames stay legacy JSON, and clients that never offer the
+subprotocol get the v1 framing untouched — the fallback needs no server
+configuration.
 """
 
 from __future__ import annotations
@@ -16,6 +23,17 @@ import asyncio
 from aiohttp import WSMsgType, web
 
 from pygrid_tpu.node.events import Connection, _handler_of, route_requests
+from pygrid_tpu.serde import (
+    decode_frame,
+    encode_frame,
+    offered_subprotocols,
+    serialize,
+    subprotocol_codec,
+)
+
+#: every subprotocol variant this build can serve — aiohttp picks the
+#: first of the client's offers present here (client preference wins)
+_SERVER_SUBPROTOCOLS = tuple(offered_subprotocols("auto"))
 
 
 async def ws_handler(request: web.Request) -> web.StreamResponse:
@@ -34,10 +52,38 @@ async def ws_handler(request: web.Request) -> web.StreamResponse:
             {"node_id": ctx.id, "message": "pygrid-tpu node"}
         )
 
-    ws = web.WebSocketResponse(max_msg_size=256 * 1024 * 1024)
+    ws = web.WebSocketResponse(
+        max_msg_size=256 * 1024 * 1024, protocols=_SERVER_SUBPROTOCOLS
+    )
     await ws.prepare(request)
     conn = Connection(ctx, socket=ws)
+    conn.wire_v2, conn.wire_codec = subprotocol_codec(ws.ws_protocol)
     loop = asyncio.get_running_loop()
+    def _process(payload):
+        """Unframe → route → frame, all ON THE EXECUTOR THREAD: per-frame
+        decompression/compression of megabyte payloads must not stall the
+        event loop any more than the handlers themselves."""
+        if conn.wire_v2 and not isinstance(payload, str):
+            try:
+                payload = decode_frame(payload)
+            except ValueError as err:
+                # a bad frame on a negotiated connection is a peer bug —
+                # answer typed, keep the socket alive
+                return encode_frame(
+                    serialize({"error": f"bad wire-v2 frame: {err}"})
+                )
+        response = route_requests(ctx, payload, conn)
+        # one-shot handler hint: a response embedding an already-
+        # compressed payload (cached checkpoint) skips the envelope
+        # codec pass — it would be redundant work per worker
+        suppress, conn.suppress_frame_codec = conn.suppress_frame_codec, False
+        if conn.wire_v2 and isinstance(
+            response, (bytes, bytearray, memoryview)
+        ):
+            codec = None if suppress else conn.wire_codec
+            response = encode_frame(bytes(response), codec)
+        return response
+
     try:
         async for msg in ws:
             if msg.type == WSMsgType.TEXT:
@@ -47,11 +93,9 @@ async def ws_handler(request: web.Request) -> web.StreamResponse:
                 # the megabyte report path; handlers never mutate frames
             else:
                 continue
-            response = await loop.run_in_executor(
-                None, route_requests, ctx, payload, conn
-            )
+            response = await loop.run_in_executor(None, _process, payload)
             try:
-                if isinstance(response, (bytes, bytearray)):
+                if isinstance(response, (bytes, bytearray, memoryview)):
                     await ws.send_bytes(bytes(response))
                 elif response is not None:
                     await ws.send_str(response)

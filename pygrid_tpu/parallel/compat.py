@@ -1,0 +1,65 @@
+"""jax version compatibility for the mesh-kernel plane.
+
+The shard_map surface moved between jax releases: ``jax.shard_map`` was
+exported at top level and grew a varying-type system (``lax.pcast``,
+``check_vma=``) replacing the older replication checker (``check_rep=``).
+The kernels in this package target the newer surface; this shim serves
+the same programs on an older jax:
+
+- ``shard_map``: top-level when present, else the experimental one, with
+  ``check_vma=`` mapped onto ``check_rep=``;
+- ``lax_pcast``: ``lax.pcast`` when present, else identity (the older
+  shard_map has no varying-type annotations to satisfy).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+try:
+    _shard_map = jax.shard_map  # newer jax: top-level export
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+#: the varying-type era is probed by its own API, NOT by where shard_map
+#: lives — the top-level export and the vma system landed in different
+#: releases, and a middle-band jax (top-level shard_map, check_rep era)
+#: must still get the kwarg mapping
+_HAS_VMA = hasattr(lax, "pcast")
+
+
+def shard_map(f, **kwargs):
+    if not _HAS_VMA:
+        kwargs.pop("check_vma", None)
+        # the old replication checker false-positives on lax.cond inside
+        # shard_map (its own error message says to pass check_rep=False);
+        # the new varying-type checker — used whenever this jax has it —
+        # keeps full checking
+        kwargs.setdefault("check_rep", False)
+    return _shard_map(f, **kwargs)
+
+
+if hasattr(lax, "pcast"):
+    lax_pcast = lax.pcast
+else:
+    def lax_pcast(x, axis_name, *, to=None):
+        # pre-varying-type jax: replicated/varying annotation is a no-op
+        return x
+
+
+def typeof_vma(x):
+    """The varying-mesh-axes set of ``x`` under the new type system, or
+    None on a jax without ``jax.typeof`` (nothing to propagate there)."""
+    if not hasattr(jax, "typeof"):
+        return None
+    return getattr(jax.typeof(x), "vma", None)
+
+
+def tpu_compiler_params():
+    """The pallas-TPU compiler-params dataclass under its current name
+    (``CompilerParams``; ``TPUCompilerParams`` on older jax)."""
+    import jax.experimental.pallas.tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    return cls if cls is not None else pltpu.TPUCompilerParams

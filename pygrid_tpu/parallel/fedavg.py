@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from pygrid_tpu.parallel.compat import lax_pcast, shard_map
 
 
 def _client_update(
@@ -106,8 +106,8 @@ def make_sharded_round(
         # aggregate every client's gradient into each local step. pcast
         # keeps local training local; only the explicit pmean below crosses
         # devices.
-        params_v = [lax.pcast(p, axis, to="varying") for p in params]
-        lr_v = lax.pcast(lr, axis, to="varying")
+        params_v = [lax_pcast(p, axis, to="varying") for p in params]
+        lr_v = lax_pcast(lr, axis, to="varying")
 
         def one_client(X, y):
             new_p, loss, acc = _client_update(

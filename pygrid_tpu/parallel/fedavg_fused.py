@@ -49,7 +49,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from pygrid_tpu.parallel.compat import lax_pcast, shard_map
 
 
 def _sgd_steps(
@@ -227,8 +227,8 @@ def make_sharded_fused_round(
     def shard_fn(params, client_X, client_y, lr):
         # pcast keeps local training local under shard_map's
         # replication-aware autodiff (see make_sharded_round's note)
-        params_v = [lax.pcast(p, axis, to="varying") for p in params]
-        lr_v = lax.pcast(lr, axis, to="varying")
+        params_v = [lax_pcast(p, axis, to="varying") for p in params]
+        lr_v = lax_pcast(lr, axis, to="varying")
 
         if local_steps > 1:
 

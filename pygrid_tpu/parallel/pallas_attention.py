@@ -41,6 +41,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from pygrid_tpu.parallel.compat import tpu_compiler_params, typeof_vma
+
+_CompilerParams = tpu_compiler_params()
+
+
 #: defaults from an on-chip sweep (v5e, L=4096 D=128 causal): 128×128
 #: blocks ran at 15 TF/s — the per-step dots were too small to feed the
 #: MXU; 512×1024 ran 6.9× faster and beats the XLA path ~3× (wall-clock,
@@ -183,7 +188,7 @@ def _fwd_impl(
         memory_space=pltpu.VMEM,
     )
 
-    vma = getattr(jax.typeof(qf), "vma", None)
+    vma = typeof_vma(qf)
     struct = partial(_struct, vma=vma)
 
     out, lse = pl.pallas_call(
@@ -204,7 +209,7 @@ def _fwd_impl(
             pltpu.VMEM((block_q, MIN_D), jnp.float32),
             pltpu.VMEM((block_q, MIN_D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -454,7 +459,7 @@ def _flash_bwd(
         jnp.broadcast_to(delta[:, None, :], (B * H, 8, Lq)), Lqp, 2
     )
 
-    vma = getattr(jax.typeof(qf), "vma", None)
+    vma = typeof_vma(qf)
     struct = partial(_struct, vma=vma)
 
     def kv_specs(index):
@@ -501,7 +506,7 @@ def _flash_bwd(
             pltpu.VMEM((bk, Dp), jnp.float32),
             pltpu.VMEM((bk, Dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -535,7 +540,7 @@ def _flash_bwd(
         ),
         out_shape=struct((B * H, Lqp, Dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, Dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

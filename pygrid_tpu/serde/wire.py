@@ -20,12 +20,17 @@ client builds can interoperate across releases.
 from __future__ import annotations
 
 import binascii
+import zlib
 from typing import Any, Callable
 
 import msgpack
 import numpy as np
 
-WIRE_VERSION = 1
+#: v2 adds the negotiated binary frame path: raw msgpack WS frames with a
+#: one-byte codec tag (optionally zstd/zlib-compressed), negotiated per
+#: connection via the ``pygrid.wire.v2`` websocket subprotocol. v1 peers
+#: never offer the subprotocol and keep the hex/base64-in-JSON framing.
+WIRE_VERSION = 2
 
 EXT_NDARRAY = 0x01
 EXT_OBJECT = 0x02
@@ -75,11 +80,40 @@ def _pack_ndarray(arr: np.ndarray) -> msgpack.ExtType:
     return msgpack.ExtType(EXT_NDARRAY, payload)
 
 
-def _unpack_ndarray(payload: bytes) -> np.ndarray:
+#: tensor-buffer byte copies made by deserialization since process start —
+#: the zero-copy regression hook: tests snapshot it around a decode and
+#: assert the delta (the hot model/diff path must stay at zero).
+_tensor_copies = 0
+
+
+def tensor_copy_count() -> int:
+    return _tensor_copies
+
+
+def _count_copy() -> None:
+    global _tensor_copies
+    _tensor_copies += 1
+
+
+def _view_f32(raw, shape) -> np.ndarray:
+    """bf16 wire bits → float32, shaped. A dtype conversion, not a buffer
+    copy (there is no f32 buffer on the wire to view)."""
+    from pygrid_tpu.native import bf16_to_f32
+
+    return bf16_to_f32(np.frombuffer(raw, dtype=np.uint16)).reshape(shape)
+
+
+def _unpack_ndarray(payload: bytes, copy: bool) -> np.ndarray:
     dtype_str, shape, raw = msgpack.unpackb(payload, raw=False)
-    # bytearray copy => writable result (frombuffer over bytes is read-only,
-    # which breaks in-place param updates downstream).
-    return np.frombuffer(bytearray(raw), dtype=np.dtype(dtype_str)).reshape(shape)
+    if copy:
+        # bytearray copy => writable result (frombuffer over bytes is
+        # read-only) — the opt-in for callers that mutate in place.
+        _count_copy()
+        raw = bytearray(raw)
+    arr = np.frombuffer(raw, dtype=np.dtype(dtype_str))
+    if not copy:
+        arr.flags.writeable = False
+    return arr.reshape(shape)
 
 
 def _pack_ndarray_bf16(arr: np.ndarray) -> msgpack.ExtType:
@@ -94,11 +128,10 @@ def _pack_ndarray_bf16(arr: np.ndarray) -> msgpack.ExtType:
 
 
 def _unpack_ndarray_bf16(payload: bytes) -> np.ndarray:
-    from pygrid_tpu.native import bf16_to_f32
-
     shape, raw = msgpack.unpackb(payload, raw=False)
-    bits = np.frombuffer(bytearray(raw), dtype=np.uint16)
-    return bf16_to_f32(bits).reshape(shape)
+    # the f32 materialization is freshly allocated either way — always
+    # writable, never a counted buffer copy
+    return _view_f32(raw, shape)
 
 
 def _make_default(bf16_floats: bool):
@@ -142,27 +175,37 @@ def _make_default(bf16_floats: bool):
 _default = _make_default(bf16_floats=False)
 
 
-def _ext_hook(code: int, payload: bytes):
-    if code == EXT_NDARRAY:
-        return _unpack_ndarray(payload)
-    if code == EXT_NDARRAY_BF16:
-        return _unpack_ndarray_bf16(payload)
-    if code == EXT_OBJECT:
-        unpacker = msgpack.Unpacker(
-            raw=False, ext_hook=_ext_hook, strict_map_key=False
-        )
-        unpacker.feed(payload)
-        # Read the leading type name alone, register its class (may import the
-        # defining module), then decode the payload exactly once.
-        type_name = unpacker.unpack()
-        _ensure_registered(type_name)
-        entry = _REGISTRY.get(type_name)
-        if entry is None:
-            raise TypeError(f"pygrid_tpu.serde: unknown wire type {type_name!r}")
-        data = unpacker.unpack()
-        _, _, unbufferize = entry
-        return unbufferize(data)
-    return msgpack.ExtType(code, payload)
+def _make_ext_hook(copy: bool):
+    def _hook(code: int, payload: bytes):
+        if code == EXT_NDARRAY:
+            return _unpack_ndarray(payload, copy)
+        if code == EXT_NDARRAY_BF16:
+            return _unpack_ndarray_bf16(payload)
+        if code == EXT_OBJECT:
+            unpacker = msgpack.Unpacker(
+                raw=False, ext_hook=_hook, strict_map_key=False
+            )
+            unpacker.feed(payload)
+            # Read the leading type name alone, register its class (may
+            # import the defining module), then decode the payload exactly
+            # once.
+            type_name = unpacker.unpack()
+            _ensure_registered(type_name)
+            entry = _REGISTRY.get(type_name)
+            if entry is None:
+                raise TypeError(
+                    f"pygrid_tpu.serde: unknown wire type {type_name!r}"
+                )
+            data = unpacker.unpack()
+            _, _, unbufferize = entry
+            return unbufferize(data)
+        return msgpack.ExtType(code, payload)
+
+    return _hook
+
+
+_ext_hook = _make_ext_hook(copy=False)
+_ext_hook_copy = _make_ext_hook(copy=True)
 
 
 #: Modules that register wire types as an import side effect. Deserialization
@@ -198,12 +241,29 @@ def serialize(obj: Any, *, bf16_floats: bool = False) -> bytes:
     return msgpack.packb(obj, use_bin_type=True, default=default)
 
 
-def deserialize(blob: bytes | bytearray | memoryview) -> Any:
-    if not isinstance(blob, bytes):
-        blob = bytes(blob)  # msgpack keeps no reference past unpackb, but
-        # normalize non-bytes views; the common (bytes) case is zero-copy
+def deserialize(
+    blob: bytes | bytearray | memoryview, *, copy: bool = False
+) -> Any:
+    """Decode a wire blob.
+
+    ``copy=False`` (the default) returns tensors as READ-ONLY views: a
+    plain dense State decodes with zero tensor-buffer copies — each
+    array aliases ``blob`` directly (the array's ``base`` keeps it
+    alive); other envelopes alias the ext payload bytes the msgpack
+    parser produced. Callers that mutate decoded tensors in place opt
+    into ``copy=True`` for writable arrays (the v1 behavior)."""
+    if not copy:
+        try:
+            state = _cursor_state_object(blob)
+        except Exception:  # noqa: BLE001 — malformed → general parse raises
+            state = None
+        if state is not None:
+            return state
     return msgpack.unpackb(
-        blob, raw=False, ext_hook=_ext_hook, strict_map_key=False
+        blob,
+        raw=False,
+        ext_hook=_ext_hook_copy if copy else _ext_hook,
+        strict_map_key=False,
     )
 
 
@@ -338,11 +398,14 @@ class _Cursor:
         return {self.read(): self.read() for _ in range(n)}
 
 
-def _cursor_state(blob) -> list[RawTensor] | None:
-    """Zero-copy walk of a dense-State wire blob: RawTensor.raw values are
-    memoryview slices of the caller's buffer (which must stay alive)."""
+def _cursor_placeholders(blob) -> list[tuple[dict, str, tuple, Any]] | None:
+    """Shared zero-copy walk of a dense-State wire blob: per placeholder,
+    ``(ph_data, kind, shape, raw)`` where ``raw`` is a memoryview slice of
+    the caller's buffer (which must stay alive) and ``kind`` is a numpy
+    dtype str or ``"bf16"``. None when the blob is not a plain dense
+    State (the callers then fall back to the general parse)."""
     top = _Cursor(memoryview(blob).cast("B")).read()
-    out: list[RawTensor] = []
+    out = []
     for ph_code, ph_payload in _expect_obj(top, "pygrid.State")[
         "placeholders"
     ]:
@@ -351,7 +414,8 @@ def _cursor_state(blob) -> list[RawTensor] | None:
         ph = _Cursor(ph_payload)
         if ph.read() != "pygrid.PlaceHolder":
             return None
-        tensor = ph.read().get("tensor")
+        ph_data = ph.read()
+        tensor = ph_data.get("tensor")
         if not isinstance(tensor, tuple):
             return None
         code, payload = tensor
@@ -365,8 +429,50 @@ def _cursor_state(blob) -> list[RawTensor] | None:
             return None
         if not isinstance(raw, memoryview):
             return None
-        out.append(RawTensor(dtype_str, tuple(shape), raw))
+        out.append((ph_data, dtype_str, tuple(shape), raw))
     return out
+
+
+def _cursor_state(blob) -> list[RawTensor] | None:
+    """Zero-copy walk of a dense-State wire blob: RawTensor.raw values are
+    memoryview slices of the caller's buffer (which must stay alive)."""
+    walked = _cursor_placeholders(blob)
+    if walked is None:
+        return None
+    return [
+        RawTensor(kind, shape, raw) for _, kind, shape, raw in walked
+    ]
+
+
+def _cursor_state_object(blob):
+    """Zero-copy decode of a plain dense State: ndarray leaves are
+    read-only views over ``blob`` itself (no msgpack ext-payload copy,
+    no buffer copy). Returns None for anything that isn't such a State;
+    raises on inconsistent headers so the caller falls back to the
+    general parser, which owns error reporting."""
+    walked = _cursor_placeholders(blob)
+    if walked is None:
+        return None
+    from pygrid_tpu.plans.placeholder import PlaceHolder
+    from pygrid_tpu.plans.state import State
+
+    placeholders = []
+    for ph_data, kind, shape, raw in walked:
+        if kind == "bf16":
+            arr = _view_f32(raw, shape)
+        else:
+            arr = np.frombuffer(raw, dtype=np.dtype(kind))
+            arr.flags.writeable = False  # raw may view a writable buffer
+            arr = arr.reshape(shape)
+        placeholders.append(
+            PlaceHolder(
+                tensor=arr,
+                id=ph_data.get("id"),
+                tags=set(ph_data.get("tags") or ()),
+                description=str(ph_data.get("description") or ""),
+            )
+        )
+    return State(placeholders)
 
 
 def _expect_obj(token, type_name: str) -> dict:
@@ -438,3 +544,131 @@ def to_hex(obj: Any) -> str:
 
 def from_hex(hexstr: str) -> Any:
     return deserialize(binascii.unhexlify(hexstr))
+
+
+# ── wire v2: negotiated binary frames + optional per-frame compression ───────
+#
+# Negotiation rides the RFC 6455 subprotocol field — no extra round trip,
+# and a peer that never heard of it (v1 client, reference syft.js client)
+# simply doesn't send the header and keeps the hex/base64-in-JSON framing.
+# On a negotiated connection every BINARY frame starts with one codec tag
+# byte; TEXT (JSON) frames are untouched in either direction, so the
+# legacy event surface stays live on the same socket.
+
+#: the subprotocol token; a negotiated codec appends ``+zstd`` / ``+zlib``
+WS_SUBPROTOCOL_V2 = "pygrid.wire.v2"
+
+FRAME_RAW = 0x00
+FRAME_ZLIB = 0x01
+FRAME_ZSTD = 0x02
+_CODEC_TAGS = {"zlib": FRAME_ZLIB, "zstd": FRAME_ZSTD}
+
+try:  # optional dependency — the container may not ship it
+    import zstandard as _zstd
+except ImportError:
+    _zstd = None
+
+#: frames below this never compress: the tag byte + codec header would
+#: cost more than they save, and serde payloads this small are control
+#: messages, not tensors
+MIN_COMPRESS_BYTES = 512
+
+#: decompression output cap — matches the websocket max frame size, so a
+#: hostile tiny frame cannot expand into gigabytes of node RSS
+MAX_DECOMPRESSED_BYTES = 1 << 28
+
+
+def available_codecs() -> tuple[str, ...]:
+    """Codecs this build can actually run, preference-ordered. zstd only
+    when the ``zstandard`` module is importable; zlib is stdlib."""
+    return ("zstd", "zlib") if _zstd is not None else ("zlib",)
+
+
+def offered_subprotocols(codec: str | None = "auto") -> list[str]:
+    """Client-side offer list, preference-ordered (compressed variants
+    first, plain v2 last so a codec-less server still negotiates v2).
+    ``codec=None`` offers plain v2 only; ``"auto"`` offers everything this
+    build supports."""
+    if codec == "auto":
+        offers = [f"{WS_SUBPROTOCOL_V2}+{c}" for c in available_codecs()]
+    elif codec:
+        if codec not in available_codecs():
+            raise ValueError(
+                f"codec {codec!r} not available (have {available_codecs()})"
+            )
+        offers = [f"{WS_SUBPROTOCOL_V2}+{codec}"]
+    else:
+        offers = []
+    return offers + [WS_SUBPROTOCOL_V2]
+
+
+def subprotocol_codec(proto: str | None) -> tuple[bool, str | None]:
+    """``(v2_negotiated, codec)`` from the handshake's selected
+    subprotocol. Anything unrecognized — including a ``+codec`` suffix
+    this build can't run — degrades to not-negotiated, never an error:
+    the legacy framing always works."""
+    if not proto or not str(proto).startswith(WS_SUBPROTOCOL_V2):
+        return False, None
+    if proto == WS_SUBPROTOCOL_V2:
+        return True, None
+    suffix = str(proto)[len(WS_SUBPROTOCOL_V2):]
+    if suffix.startswith("+"):
+        codec = suffix[1:]
+        if codec in available_codecs():
+            return True, codec
+    return False, None
+
+
+def encode_frame(payload: bytes, codec: str | None = None) -> bytes:
+    """Wrap a serde payload for a v2 connection: one codec tag byte, then
+    the (possibly compressed) payload. Compression is per-frame and only
+    kept when it actually wins — high-entropy float payloads commonly
+    don't shrink, and shipping them raw costs one tag byte."""
+    if codec and len(payload) >= MIN_COMPRESS_BYTES:
+        if codec == "zstd" and _zstd is not None:
+            packed = _zstd.ZstdCompressor(level=3).compress(bytes(payload))
+            tag = FRAME_ZSTD
+        elif codec == "zlib":
+            packed = zlib.compress(bytes(payload), level=1)
+            tag = FRAME_ZLIB
+        else:
+            raise ValueError(f"unknown frame codec {codec!r}")
+        if len(packed) < len(payload):
+            return bytes((tag,)) + packed
+    return b"\x00" + bytes(payload)
+
+
+def decode_frame(frame: bytes | bytearray | memoryview) -> Any:
+    """Unwrap a v2 binary frame → the serde payload. Raw frames return a
+    zero-copy memoryview into ``frame``; compressed frames return fresh
+    bytes, output-capped so a hostile frame can't balloon node memory."""
+    view = memoryview(frame)
+    if len(view) < 1:
+        raise ValueError("empty wire-v2 frame")
+    tag = view[0]
+    body = view[1:]
+    if tag == FRAME_RAW:
+        return body
+    if tag == FRAME_ZLIB:
+        d = zlib.decompressobj()
+        try:
+            out = d.decompress(bytes(body), MAX_DECOMPRESSED_BYTES)
+        except zlib.error as err:  # peer-supplied bytes → typed error
+            raise ValueError(f"bad zlib frame: {err}") from err
+        if len(out) >= MAX_DECOMPRESSED_BYTES:
+            raise ValueError("wire-v2 frame decompresses past the size cap")
+        if not d.eof or d.unused_data:
+            # a truncated-but-valid prefix decompresses without raising —
+            # partial payload must be a typed error, not garbage msgpack
+            raise ValueError("bad zlib frame: truncated or trailing bytes")
+        return out
+    if tag == FRAME_ZSTD:
+        if _zstd is None:
+            raise ValueError("zstd frame received but zstandard not installed")
+        try:
+            return _zstd.ZstdDecompressor().decompress(
+                bytes(body), max_output_size=MAX_DECOMPRESSED_BYTES
+            )
+        except _zstd.ZstdError as err:
+            raise ValueError(f"bad zstd frame: {err}") from err
+    raise ValueError(f"unknown wire-v2 frame tag {tag:#x}")

@@ -33,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pygrid_tpu.smpc import ring as R
 from pygrid_tpu.smpc.kernels import share_kernel
 
-shard_map = jax.shard_map
+from pygrid_tpu.parallel.compat import shard_map
 
 
 def party_sharding(mesh: Mesh, axis: str = "parties") -> NamedSharding:

@@ -50,6 +50,11 @@ class WEBRTC_EVENTS:
 
 class MODEL_CENTRIC_FL_EVENTS:
     HOST_FL_TRAINING = "model-centric/host-training"
+    #: WS twin of GET /model-centric/get-model — on the negotiated binary
+    #: wire the checkpoint rides the same socket as the rest of the cycle
+    #: (raw bytes, no base64); this framework's extension, absent in the
+    #: reference (its download is HTTP-only)
+    GET_MODEL = "model-centric/get-model"
     REPORT = "model-centric/report"
     AUTHENTICATE = "model-centric/authenticate"
     CYCLE_REQUEST = "model-centric/cycle-request"

@@ -1,0 +1,17 @@
+#!/usr/bin/env sh
+# Wire-path smoke: tiny checkpoint sizes, CPU only, no sockets — catches
+# encode/decode and bytes-ratio regressions in seconds, without a TPU or a
+# live node. The same assertions run under tier-1 via
+# tests/unit/test_bench_wire.py; the full-size capture is bench.py's
+# bench_wire() section (recorded into the round's BENCH file).
+#
+# Usage: scripts/bench_wire.sh [--full]
+set -e
+cd "$(dirname "$0")/.."
+TINY=True
+[ "$1" = "--full" ] && TINY=False
+JAX_PLATFORMS=cpu python -c "
+import json
+from bench import bench_wire
+print(json.dumps(bench_wire(tiny=$TINY), indent=2))
+"

@@ -1,0 +1,208 @@
+"""Wire-v2 negotiation interop over real WebSockets.
+
+The acceptance matrix for the negotiated binary wire path:
+
+- an old-protocol client (hex/base64-in-JSON frames, never offers the
+  subprotocol) completes a full FL cycle against the new node unchanged;
+- a ``wire="auto"`` client negotiates v2 at the websocket handshake and
+  completes the same cycle over binary frames (checkpoint download
+  included — it rides the socket, not HTTP);
+- both framings coexist inside ONE cycle;
+- the HTTP download path serves a compressed body only to clients that
+  asked for it, detected by response header so old nodes interoperate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from pygrid_tpu.client import FLClient, ModelCentricFLClient
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.serde import available_codecs
+from pygrid_tpu.utils.codes import CYCLE, MSG_FIELD
+
+D, H, C, B = 64, 16, 4, 8
+NAME = "wire-v2-interop"
+
+
+def _host(grid, name: str, min_diffs: int = 1) -> list:
+    params = [np.asarray(p) for p in mlp.init(jax.random.PRNGKey(3), (D, H, C))]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(grid.node_url("bob"))
+    response = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={"name": name, "version": "1.0"},
+        server_config={
+            "min_workers": min_diffs,
+            "max_workers": 4,
+            "min_diffs": min_diffs,
+            "max_diffs": min_diffs,
+            "num_cycles": 9,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+        },
+    )
+    assert response.get("status") == "success", response
+    mc.close()
+    return params
+
+
+def _run_cycle(client: FLClient, name: str, scale: float) -> list:
+    """authenticate → cycle-request → model download → report; returns the
+    downloaded params (the full hot loop, whatever the framing)."""
+    auth = client.authenticate(name, "1.0")
+    assert auth.get("status") == "success", auth
+    wid = auth[MSG_FIELD.WORKER_ID]
+    cycle = client.cycle_request(
+        wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cycle.get(CYCLE.STATUS) == "accepted", cycle
+    params = client.get_model(wid, cycle[CYCLE.KEY], cycle[MSG_FIELD.MODEL_ID])
+    from pygrid_tpu.plans.state import serialize_model_params
+
+    diff = [scale * np.asarray(p) for p in params]
+    report = client.report(wid, cycle[CYCLE.KEY], serialize_model_params(diff))
+    assert report.get(CYCLE.STATUS) == "success", report
+    return params
+
+
+def _latest_checkpoint(grid, name: str) -> list:
+    from pygrid_tpu.plans.state import unserialize_model_params
+
+    resp = requests.get(
+        grid.node_url("bob") + "/model-centric/retrieve-model",
+        params={"name": name, "version": "1.0"},
+        timeout=10,
+    )
+    assert resp.status_code == 200, resp.text
+    return unserialize_model_params(resp.content)
+
+
+def test_legacy_json_client_completes_full_cycle(grid):
+    """The acceptance case: a hex/base64-JSON client — wire-identical to a
+    v1 build, no subprotocol offer — runs the whole FL cycle against the
+    binary-capable node and moves the checkpoint."""
+    name = NAME + "-json"
+    hosted = _host(grid, name)
+    client = FLClient(grid.node_url("bob"), wire="json")
+    before = _latest_checkpoint(grid, name)
+    downloaded = _run_cycle(client, name, scale=0.25)
+    # the json-pinned client never negotiated v2
+    assert client.ws.wire_v2 is False
+    assert client.ws._ws.subprotocol is None
+    np.testing.assert_allclose(downloaded[0], hosted[0], atol=1e-6)
+    after = _latest_checkpoint(grid, name)
+    # aggregation applied the diff: new = old - 0.25*old = 0.75*old
+    np.testing.assert_allclose(after[0], 0.75 * np.asarray(before[0]), atol=1e-5)
+    client.close()
+
+
+def test_auto_client_negotiates_binary_and_completes_cycle(grid):
+    name = NAME + "-auto"
+    hosted = _host(grid, name)
+    client = FLClient(grid.node_url("bob"), wire="auto", codec="auto")
+    downloaded = _run_cycle(client, name, scale=0.5)
+    assert client.ws.wire_v2 is True
+    assert client.ws.wire_codec in available_codecs()
+    np.testing.assert_allclose(downloaded[0], hosted[0], atol=1e-6)
+    after = _latest_checkpoint(grid, name)
+    np.testing.assert_allclose(after[0], 0.5 * np.asarray(hosted[0]), atol=1e-5)
+    client.close()
+
+
+def test_both_framings_coexist_in_one_cycle(grid):
+    """One cycle, two reporters: a legacy JSON client and a negotiated
+    binary client. The node aggregates both diffs identically."""
+    name = NAME + "-mixed"
+    hosted = _host(grid, name, min_diffs=2)
+    legacy = FLClient(grid.node_url("bob"), wire="json")
+    binary = FLClient(grid.node_url("bob"), wire="auto")
+    try:
+        from pygrid_tpu.plans.state import serialize_model_params
+
+        keys = []
+        for client in (legacy, binary):
+            auth = client.authenticate(name, "1.0")
+            wid = auth[MSG_FIELD.WORKER_ID]
+            cycle = client.cycle_request(
+                wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+            )
+            assert cycle.get(CYCLE.STATUS) == "accepted", cycle
+            params = client.get_model(
+                wid, cycle[CYCLE.KEY], cycle[MSG_FIELD.MODEL_ID]
+            )
+            keys.append((client, wid, cycle[CYCLE.KEY], params))
+        assert binary.ws.wire_v2 and not legacy.ws.wire_v2
+        for client, wid, key, params in keys:
+            diff = [0.5 * np.asarray(p) for p in params]
+            report = client.report(wid, key, serialize_model_params(diff))
+            assert report.get(CYCLE.STATUS) == "success", report
+        after = _latest_checkpoint(grid, name)
+        # both diffs were 0.5*params → mean is 0.5*params → new = 0.5*old
+        np.testing.assert_allclose(
+            after[0], 0.5 * np.asarray(hosted[0]), atol=1e-5
+        )
+    finally:
+        legacy.close()
+        binary.close()
+
+
+def test_http_download_codec_negotiated_by_header(grid):
+    """A json-wire client opting into HTTP body compression gets the same
+    params; the compressed body is detected via the response header, so
+    a node that ignored the param would still interoperate."""
+    name = NAME + "-codec"
+    hosted = _host(grid, name)
+    codec = available_codecs()[0]
+    client = FLClient(grid.node_url("bob"), wire="json", codec=codec)
+    downloaded = _run_cycle(client, name, scale=0.1)
+    assert client._http.last_headers.get("x-pygrid-wire") == "v2-frame"
+    np.testing.assert_allclose(downloaded[0], hosted[0], atol=1e-6)
+    client.close()
+
+
+def test_bf16_precision_over_ws_download(grid):
+    """precision=bf16 composes with the WS (binary) download path."""
+    name = NAME + "-bf16"
+    hosted = _host(grid, name)
+    client = FLClient(grid.node_url("bob"), wire="auto")
+    auth = client.authenticate(name, "1.0")
+    wid = auth[MSG_FIELD.WORKER_ID]
+    cycle = client.cycle_request(
+        wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cycle.get(CYCLE.STATUS) == "accepted", cycle
+    params = client.get_model(
+        wid, cycle[CYCLE.KEY], cycle[MSG_FIELD.MODEL_ID], precision="bf16"
+    )
+    assert client.ws.wire_v2 is True
+    np.testing.assert_allclose(params[0], hosted[0], atol=0.02, rtol=0.01)
+    client.close()
+
+
+def test_ws_get_model_rejects_bad_request_key(grid):
+    from pygrid_tpu.utils.exceptions import PyGridError
+
+    name = NAME + "-badkey"
+    _host(grid, name)
+    client = FLClient(grid.node_url("bob"), wire="auto")
+    auth = client.authenticate(name, "1.0")
+    wid = auth[MSG_FIELD.WORKER_ID]
+    cycle = client.cycle_request(
+        wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+    )
+    with pytest.raises(PyGridError):
+        client.get_model(wid, "wrong-key", cycle[MSG_FIELD.MODEL_ID])
+    client.close()

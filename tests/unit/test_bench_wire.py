@@ -1,0 +1,33 @@
+"""CI gate for the wire-v2 hot path (scripts/bench_wire.sh's twin):
+encode/decode must round-trip, the binary framing must beat the legacy
+hex-JSON framing on bytes by the tentpole margin, and checkpoint decode
+must stay zero-copy. Regressions here fail tier-1 rather than only
+showing up in the next BENCH capture."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from bench import bench_wire  # noqa: E402
+
+
+def test_wire_bench_smoke_ratios_and_zero_copy():
+    out = bench_wire(tiny=True)
+    for model in ("mlp", "transformer"):
+        # hex removal alone is 2x on payload bytes; envelope overhead on
+        # the tiny shapes eats a little of it — 1.8x is the floor
+        assert out[f"wire_{model}_bytes_ratio"] >= 1.8, out
+        # bf16 composes on top of the binary framing
+        assert (
+            out[f"wire_{model}_bytes_ratio_bf16"]
+            > out[f"wire_{model}_bytes_ratio"]
+        ), out
+        # the read-only-view contract: checkpoint decode copies no
+        # tensor buffers (asserted via the serde copy-count hook)
+        assert out[f"wire_{model}_decode_tensor_copies"] == 0, out
+        assert out[f"wire_{model}_encode_ms_v2"] > 0
+        assert out[f"wire_{model}_decode_ms_v2"] > 0
+    assert "zlib" in out["wire_codecs_available"]

@@ -201,7 +201,22 @@ def test_poison_diff_does_not_count_toward_readiness():
 
 # --- property-based: the invariants hold for arbitrary shapes/fractions ----
 
-from hypothesis import given, settings, strategies as st
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:  # keep the non-property suite above running
+    def given(*a, **k):
+        return lambda f: pytest.mark.skip(
+            reason="hypothesis not installed"
+        )(f)
+
+    def settings(*a, **k):
+        return lambda f: f
+
+    class _StrategyStub:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    st = _StrategyStub()
 
 
 @settings(max_examples=40, deadline=None)

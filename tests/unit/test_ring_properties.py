@@ -8,6 +8,8 @@ boundaries, zeros)."""
 from __future__ import annotations
 
 import numpy as np
+import pytest
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from pygrid_tpu.smpc import ring as R

@@ -61,9 +61,18 @@ def test_model_params_serde():
         np.testing.assert_array_equal(a, b)
 
 
-def test_deserialized_arrays_are_writable():
-    out = serde.deserialize(serde.serialize(np.zeros((2, 2), np.float32)))
-    out[0, 0] = 5.0  # reference returns mutable tensors; so must we
+def test_deserialized_arrays_are_readonly_views_by_default():
+    # wire v2: decode is zero-copy — tensors are read-only views; callers
+    # that mutate opt into copy=True (the v1 writable behavior)
+    blob = serde.serialize(np.zeros((2, 2), np.float32))
+    view = serde.deserialize(blob)
+    assert not view.flags.writeable
+
+
+def test_deserialize_copy_returns_writable_arrays():
+    blob = serde.serialize(np.zeros((2, 2), np.float32))
+    out = serde.deserialize(blob, copy=True)
+    out[0, 0] = 5.0  # the reference's mutable-tensor contract, on request
     assert out[0, 0] == 5.0
 
 

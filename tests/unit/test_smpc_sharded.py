@@ -146,7 +146,22 @@ def test_sharded_beaver_single_device_mesh():
 
 # --- property-based: ring_psum is the exact host sum for any inputs --------
 
-from hypothesis import given, settings, strategies as st
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:  # keep the non-property suite above running
+    def given(*a, **k):
+        return lambda f: pytest.mark.skip(
+            reason="hypothesis not installed"
+        )(f)
+
+    def settings(*a, **k):
+        return lambda f: f
+
+    class _StrategyStub:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    st = _StrategyStub()
 
 
 @settings(max_examples=30, deadline=None)

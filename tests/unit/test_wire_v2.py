@@ -1,0 +1,285 @@
+"""Wire v2: binary-frame serde round trips, zero-copy decode semantics,
+frame codec + subprotocol negotiation, and the model-blob cache's
+publish-invalidation invariant. The transport-level interop (a hex-JSON
+client against a binary-capable node) lives in
+tests/integration/test_wire_v2_interop.py."""
+
+from __future__ import annotations
+
+import types
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.serde import (
+    WIRE_VERSION,
+    WS_SUBPROTOCOL_V2,
+    available_codecs,
+    decode_frame,
+    deserialize,
+    encode_frame,
+    offered_subprotocols,
+    serialize,
+    subprotocol_codec,
+    tensor_copy_count,
+)
+from pygrid_tpu.serde import wire as wire_mod
+from pygrid_tpu.plans.state import (
+    State,
+    serialize_model_params,
+    unserialize_model_params,
+)
+
+
+# ── round-trip property grid: dtypes × shapes × bf16 × codec ─────────────────
+
+DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]
+SHAPES = [(), (1,), (7,), (3, 5), (2, 3, 4), (1, 1, 1, 6), (0, 4)]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_roundtrip_dtype_shape(dtype, shape):
+    rng = np.random.default_rng(42)
+    # np.asarray: numpy collapses 0-d results to scalars (np.float64
+    # subclasses float and would msgpack natively) — the wire contract
+    # under test is the ndarray ext, so pin the ndarray type
+    arr = np.asarray((rng.standard_normal(shape) * 10).astype(dtype))
+    out = deserialize(serialize({"t": arr}))["t"]
+    assert out.dtype == arr.dtype
+    assert out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+@pytest.mark.parametrize("bf16", [False, True])
+@pytest.mark.parametrize("codec", [None] + list(available_codecs()))
+@pytest.mark.parametrize(
+    "shapes", [[(4, 3), (3,)], [(17,)], [(2, 2, 2), (1,), (5, 1)]]
+)
+def test_state_roundtrip_through_frames(shapes, bf16, codec):
+    """The full binary wire path: State → serde → frame → unframe → serde —
+    across payload precisions and negotiated frame codecs."""
+    rng = np.random.default_rng(7)
+    params = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+    blob = serialize_model_params(params, bf16=bf16)
+    frame = encode_frame(blob, codec)
+    out = unserialize_model_params(bytes(decode_frame(frame)))
+    assert len(out) == len(params)
+    for got, want in zip(out, params):
+        assert got.shape == want.shape
+        if bf16:
+            np.testing.assert_allclose(got, want, atol=0.02, rtol=0.01)
+        else:
+            np.testing.assert_array_equal(got, want)
+
+
+def test_state_fast_path_preserves_placeholder_identity():
+    """The zero-copy cursor decode must reconstruct the same State the
+    general parser would: ids, tags, descriptions, tensor values."""
+    from pygrid_tpu.plans.placeholder import PlaceHolder
+
+    ph = PlaceHolder(
+        tensor=np.arange(6, dtype=np.float32).reshape(2, 3),
+        id=1234567,
+        tags={"a", "b"},
+        description="weights",
+    )
+    blob = serialize(State([ph]))
+    out = deserialize(blob)
+    assert isinstance(out, State)
+    got = out.state_placeholders[0]
+    assert got.id == 1234567
+    assert got.tags == {"a", "b"}
+    assert got.description == "weights"
+    np.testing.assert_array_equal(got.tensor, ph.tensor)
+
+
+# ── zero-copy semantics ──────────────────────────────────────────────────────
+
+
+def test_deserialize_views_are_read_only_and_zero_copy():
+    params = [np.random.rand(64, 32).astype(np.float32)]
+    blob = serialize_model_params(params)
+    before = tensor_copy_count()
+    state = deserialize(blob)
+    tensors = state.tensors()
+    assert tensor_copy_count() == before  # the hot-loop invariant
+    assert not tensors[0].flags.writeable
+    with pytest.raises((ValueError, RuntimeError)):
+        tensors[0][0, 0] = 1.0
+    # the view aliases the wire blob, not a copy of it
+    assert tensors[0].base is not None
+
+
+def test_deserialize_copy_opt_in_is_writable_and_counted():
+    arr = np.random.rand(8, 8).astype(np.float32)
+    blob = serialize({"x": arr})
+    before = tensor_copy_count()
+    out = deserialize(blob, copy=True)["x"]
+    assert tensor_copy_count() == before + 1
+    out[0, 0] = 42.0  # writable — the opt-in's whole point
+    assert out[0, 0] == 42.0
+
+
+def test_transformer_sized_checkpoint_decodes_with_zero_copies():
+    """Acceptance criterion: a transformer-sized checkpoint deserializes
+    with zero tensor-buffer copies, via the copy-counting hook."""
+    rng = np.random.default_rng(3)
+    shapes = [(8192, 64), (64, 192), (192,), (64, 256), (256, 64), (64, 8192)]
+    params = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+    blob = serialize_model_params(params)
+    before = tensor_copy_count()
+    out = deserialize(blob)
+    assert tensor_copy_count() == before
+    for got, want in zip(out.tensors(), params):
+        np.testing.assert_array_equal(got, want)
+
+
+# ── frame codec ──────────────────────────────────────────────────────────────
+
+
+def test_frame_raw_is_zero_copy_view():
+    payload = b"x" * 1000
+    frame = encode_frame(payload)
+    assert frame[0] == wire_mod.FRAME_RAW
+    body = decode_frame(frame)
+    assert isinstance(body, memoryview)
+    assert bytes(body) == payload
+
+
+def test_frame_compression_only_when_it_wins():
+    compressible = b"\x00" * 100_000
+    frame = encode_frame(compressible, "zlib")
+    assert frame[0] == wire_mod.FRAME_ZLIB
+    assert len(frame) < 1000
+    assert bytes(decode_frame(frame)) == compressible
+    # high-entropy payloads ship raw even when a codec is negotiated
+    noisy = np.random.default_rng(0).bytes(100_000)
+    assert encode_frame(noisy, "zlib")[0] == wire_mod.FRAME_RAW
+    # tiny payloads never pay the codec header
+    assert encode_frame(b"\x00" * 100, "zlib")[0] == wire_mod.FRAME_RAW
+
+
+def test_frame_rejects_garbage():
+    with pytest.raises(ValueError):
+        decode_frame(b"")
+    with pytest.raises(ValueError):
+        decode_frame(b"\x7fjunk")
+    with pytest.raises(ValueError):
+        decode_frame(bytes([wire_mod.FRAME_ZLIB]) + b"not-zlib")
+
+
+def test_truncated_zlib_frame_is_typed_error():
+    import zlib
+
+    whole = zlib.compress(b"\x01" * 10_000)
+    truncated = bytes([wire_mod.FRAME_ZLIB]) + whole[: len(whole) // 2]
+    with pytest.raises(ValueError):  # partial output must never leak out
+        decode_frame(truncated)
+
+
+def test_forced_codec_validated_at_construction():
+    from pygrid_tpu.client import FLClient
+
+    with pytest.raises(ValueError):
+        FLClient("http://127.0.0.1:1", wire="json", codec="brotli")
+
+
+def test_decompression_bomb_capped(monkeypatch):
+    import zlib
+
+    monkeypatch.setattr(wire_mod, "MAX_DECOMPRESSED_BYTES", 4096)
+    bomb = bytes([wire_mod.FRAME_ZLIB]) + zlib.compress(b"\x00" * 1_000_000)
+    with pytest.raises(ValueError):
+        decode_frame(bomb)
+
+
+# ── negotiation ──────────────────────────────────────────────────────────────
+
+
+def test_wire_version_bumped():
+    assert WIRE_VERSION >= 2
+
+
+def test_offer_and_select_matrix():
+    # plain v2 is always the last offer (codec-less servers still match)
+    offers = offered_subprotocols("auto")
+    assert offers[-1] == WS_SUBPROTOCOL_V2
+    assert all(o.startswith(WS_SUBPROTOCOL_V2) for o in offers)
+    assert offered_subprotocols(None) == [WS_SUBPROTOCOL_V2]
+    with pytest.raises(ValueError):
+        offered_subprotocols("nope")
+    # selection → (v2, codec)
+    assert subprotocol_codec(WS_SUBPROTOCOL_V2) == (True, None)
+    for c in available_codecs():
+        assert subprotocol_codec(f"{WS_SUBPROTOCOL_V2}+{c}") == (True, c)
+    # no selection / foreign selection → legacy framing
+    assert subprotocol_codec(None) == (False, None)
+    assert subprotocol_codec("graphql-ws") == (False, None)
+    # a codec this build can't run degrades to legacy, never an error
+    assert subprotocol_codec(f"{WS_SUBPROTOCOL_V2}+brotli") == (False, None)
+
+
+# ── model-blob cache: publish invalidation (satellite) ───────────────────────
+
+
+def _model_manager():
+    from pygrid_tpu.federated.managers import ModelManager
+    from pygrid_tpu.storage import Database
+
+    return ModelManager(Database(":memory:"))
+
+
+def test_blob_cache_invalidates_on_checkpoint_publish():
+    """A new checkpoint must never serve the previous round's cached
+    bytes — for the raw blob and for every encoding variant."""
+    mm = _model_manager()
+    process = types.SimpleNamespace(id=1, version="1.0")
+    params_v1 = [np.full((16, 8), 1.0, np.float32)]
+    params_v2 = [np.full((16, 8), 2.0, np.float32)]
+    model = mm.create(serialize_model_params(params_v1), process)
+
+    first = mm.load_encoded(model.id)
+    first_bf16 = mm.load_encoded(model.id, precision="bf16")
+    codec = available_codecs()[0]
+    first_z = mm.load_encoded(model.id, codec=codec)
+    assert np.array_equal(
+        unserialize_model_params(first)[0], params_v1[0]
+    )
+
+    mm.save(model.id, serialize_model_params(params_v2))  # publish
+
+    for precision, codec_arg, stale in (
+        (None, None, first),
+        ("bf16", None, first_bf16),
+        (None, codec, first_z),
+    ):
+        blob = mm.load_encoded(model.id, precision=precision, codec=codec_arg)
+        assert blob != stale, (precision, codec_arg)
+        if codec_arg:
+            blob = bytes(decode_frame(blob))
+        got = unserialize_model_params(blob)[0]
+        np.testing.assert_allclose(got, params_v2[0], atol=0.01)
+
+
+def test_blob_cache_serves_one_encoding_per_checkpoint():
+    """K downloads of the same checkpoint+encoding hit the cache — the
+    sqlite row read and the re-encode happen once."""
+    mm = _model_manager()
+    process = types.SimpleNamespace(id=1, version="1.0")
+    model = mm.create(
+        serialize_model_params([np.random.rand(32, 8).astype(np.float32)]),
+        process,
+    )
+    mm.load_encoded(model.id, precision="bf16")
+    calls = {"n": 0}
+    real_load = mm.load
+
+    def counting_load(**kw):
+        calls["n"] += 1
+        return real_load(**kw)
+
+    mm.load = counting_load
+    for _ in range(8):  # K workers downloading the same round
+        mm.load_encoded(model.id, precision="bf16")
+    assert calls["n"] == 0
