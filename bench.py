@@ -1258,6 +1258,119 @@ def bench_wire(tiny: bool = False) -> dict:
     return out
 
 
+def bench_telemetry_overhead(tiny: bool = False) -> dict:
+    """Cost of the always-on telemetry on the wire hot loop: one
+    model-download + diff-upload round (the bench_wire framing) measured
+    bare vs instrumented exactly the way the live path is — a client
+    span per frame, the trace header on every wire-v2 frame, the frame
+    decode timing, and the byte counters. The acceptance bar is ≤ 2% on
+    both bytes and p50 latency at full checkpoint scale (PR-2 tentpole);
+    the tiny CI twin reports the same numbers on toy shapes where the
+    fixed per-call cost is proportionally larger."""
+    import numpy as np
+
+    from pygrid_tpu import telemetry
+    from pygrid_tpu.plans.state import serialize_model_params
+    from pygrid_tpu.serde import (
+        decode_frame_traced,
+        deserialize,
+        encode_frame,
+        serialize,
+    )
+    from pygrid_tpu.telemetry import trace
+
+    rng = np.random.default_rng(0)
+    repeats = 9 if tiny else 25
+    shapes = (_WIRE_MODELS_TINY if tiny else _WIRE_MODELS)["transformer"]
+    params = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+    diffs = [0.01 * p for p in params]
+    model_blob = serialize_model_params(params)
+    diff_blob = serialize_model_params(diffs)
+    head = {"worker_id": "w" * 36, "request_key": "k" * 64}
+
+    def _frames(with_trace: bool) -> tuple[bytes, bytes]:
+        # the live client carries the context twice: the envelope's
+        # `trace` field (GridWSClient._request) AND the frame header —
+        # the instrumented round must pay both or the certified byte
+        # delta is not the live wire's
+        tb = trace.to_bytes() if with_trace else None
+        envelope_trace = (
+            {"trace": trace.header()} if with_trace else {}
+        )
+        down = encode_frame(serialize({
+            "type": "model-centric/get-model",
+            **envelope_trace,
+            "data": {**head, "model": model_blob},
+        }), trace=tb)
+        up = encode_frame(serialize({
+            "type": "model-centric/report",
+            **envelope_trace,
+            "data": {**head, "diff": diff_blob},
+        }), trace=tb)
+        return down, up
+
+    def _round(instrumented: bool) -> None:
+        if instrumented:
+            with trace.span("client.request", event_type="bench"):
+                down, up = _frames(True)
+            for frame in (down, up):
+                telemetry.incr(
+                    "wire_bytes_total", len(frame), direction="in",
+                    codec="bench",
+                )
+                t0 = time.perf_counter()
+                payload, tb = decode_frame_traced(frame)
+                telemetry.observe(
+                    "ws_frame_decode_seconds", time.perf_counter() - t0
+                )
+                with trace.serve(trace.from_bytes(tb)):
+                    deserialize(payload)
+        else:
+            down, up = _frames(False)
+            for frame in (down, up):
+                deserialize(decode_frame_traced(frame)[0])
+
+    # genuinely interleaved A/B (one plain, one traced, repeat) so drift
+    # on a busy capture host hits both variants the same way, with one
+    # untimed warmup pair absorbing allocator/import one-offs
+    _round(False)
+    _round(True)
+    plain_times: list[float] = []
+    traced_times: list[float] = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        _round(False)
+        plain_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _round(True)
+        traced_times.append(time.perf_counter() - t0)
+    plain_ms = sorted(plain_times)[len(plain_times) // 2] * 1e3
+    traced_ms = sorted(traced_times)[len(traced_times) // 2] * 1e3
+
+    with trace.span("client.request", event_type="bench"):
+        d_t, u_t = _frames(True)
+    d_p, u_p = _frames(False)
+    bytes_plain = len(d_p) + len(u_p)
+    bytes_traced = len(d_t) + len(u_t)
+    byte_pct = 100.0 * (bytes_traced - bytes_plain) / bytes_plain
+    latency_pct = 100.0 * (traced_ms - plain_ms) / plain_ms
+    out = {
+        "telemetry_roundtrip_bytes_plain": bytes_plain,
+        "telemetry_roundtrip_bytes_traced": bytes_traced,
+        "telemetry_byte_overhead_pct": round(byte_pct, 4),
+        "telemetry_roundtrip_ms_plain": round(plain_ms, 3),
+        "telemetry_roundtrip_ms_traced": round(traced_ms, 3),
+        "telemetry_latency_overhead_pct": round(latency_pct, 2),
+        "telemetry_within_2pct": bool(byte_pct <= 2.0 and latency_pct <= 2.0),
+    }
+    print(
+        f"telemetry overhead: bytes +{byte_pct:.4f}%, "
+        f"p50 {plain_ms:.3f} → {traced_ms:.3f} ms ({latency_pct:+.2f}%)",
+        file=sys.stderr,
+    )
+    return out
+
+
 def bench_report_handler() -> dict:
     """Isolated node-side report-handler latency (no sockets, no client
     threads): p50 ``route_requests`` time for a protocol-realistic report
@@ -1531,6 +1644,7 @@ def main() -> None:
     else:
         kernel = _guard_call("kernel", bench_tpu, proto, default=None)
     _guard("wire", bench_wire, proto)
+    _guard("telemetry_overhead", bench_telemetry_overhead, proto)
     _guard("protocol_json", lambda: bench_protocol("json"), proto)
     _guard("protocol_binary", lambda: bench_protocol("binary"), proto)
     _guard("report_handler", bench_report_handler, proto)

@@ -17,6 +17,7 @@ import uuid
 from typing import Any
 
 from pygrid_tpu.client.ws_transport import RawWSClient
+from pygrid_tpu.telemetry import trace
 from pygrid_tpu.utils.codes import MSG_FIELD
 
 #: bytes a JSON string cannot carry verbatim: the two escape characters,
@@ -47,6 +48,10 @@ class GridWSClient:
         self.codec = codec
         self.wire_v2 = False
         self.wire_codec: str | None = None
+        #: whether the server took the ``.trace`` subprotocol variant —
+        #: frame trace headers are sent only then (a plain-v2 server's
+        #: decoder predates the tag bit)
+        self.wire_trace = False
         self._ws = None
         # reentrant: connect() locks on its own (callers may probe
         # negotiation state before any request) and is also reached from
@@ -81,11 +86,12 @@ class GridWSClient:
                 max_size=2**28,
                 subprotocols=offers,
             )
-            from pygrid_tpu.serde import subprotocol_codec
+            from pygrid_tpu.serde import subprotocol_codec, subprotocol_traced
 
             self.wire_v2, self.wire_codec = subprotocol_codec(
                 self._ws.subprotocol
             )
+            self.wire_trace = subprotocol_traced(self._ws.subprotocol)
         return self
 
     def close(self) -> None:
@@ -121,19 +127,27 @@ class GridWSClient:
             # ride the same socket) — a counter beats per-request urandom
             self._req_seq += 1
             request_id = f"{self._req_prefix}-{self._req_seq}"
-            message: dict[str, Any] = {
-                MSG_FIELD.TYPE: msg_type,
-                MSG_FIELD.REQUEST_ID: request_id,
-            }
-            if data is not None:
-                message[MSG_FIELD.DATA] = data
-            message.update(top_level)
-            try:
-                self._ws.send(encode(message))
-                return self._recv_correlated(request_id, decode, want_bytes)
-            except (ConnectionError, TimeoutError, OSError):
-                self._drop_connection()
-                raise
+            # every request is a client span: the envelope's `trace`
+            # field (and, for wire-v2, the frame header written by the
+            # encoder reading trace.current()) carries the context so
+            # node-side spans stitch into the same trace
+            with trace.span("client.request", event_type=msg_type) as tctx:
+                message: dict[str, Any] = {
+                    MSG_FIELD.TYPE: msg_type,
+                    MSG_FIELD.REQUEST_ID: request_id,
+                    "trace": trace.header(tctx),
+                }
+                if data is not None:
+                    message[MSG_FIELD.DATA] = data
+                message.update(top_level)
+                try:
+                    self._ws.send(encode(message))
+                    return self._recv_correlated(
+                        request_id, decode, want_bytes
+                    )
+                except (ConnectionError, TimeoutError, OSError):
+                    self._drop_connection()
+                    raise
 
     def _recv_correlated(
         self, request_id: str, decode: Any, want_bytes: bool
@@ -197,28 +211,30 @@ class GridWSClient:
             self.connect()
             self._req_seq += 1
             request_id = f"{self._req_prefix}-{self._req_seq}"
-            head = json.dumps(
-                {
-                    MSG_FIELD.TYPE: msg_type,
-                    MSG_FIELD.REQUEST_ID: request_id,
-                    MSG_FIELD.DATA: data,
-                }
-            )
-            if not head.endswith("}}"):
-                raise ValueError("unexpected JSON head shape for splice")
-            sep = ", " if data else ""
-            frame = b"".join(
-                (head[:-2].encode(), f'{sep}"{raw_key}": "'.encode(),
-                 payload, b'"}}')
-            )
-            try:
-                self._ws.send_text_bytes(frame)
-                return self._recv_correlated(
-                    request_id, json.loads, want_bytes=False
+            with trace.span("client.request", event_type=msg_type) as tctx:
+                head = json.dumps(
+                    {
+                        MSG_FIELD.TYPE: msg_type,
+                        MSG_FIELD.REQUEST_ID: request_id,
+                        "trace": trace.header(tctx),
+                        MSG_FIELD.DATA: data,
+                    }
                 )
-            except (ConnectionError, TimeoutError, OSError):
-                self._drop_connection()
-                raise
+                if not head.endswith("}}"):
+                    raise ValueError("unexpected JSON head shape for splice")
+                sep = ", " if data else ""
+                frame = b"".join(
+                    (head[:-2].encode(), f'{sep}"{raw_key}": "'.encode(),
+                     payload, b'"}}')
+                )
+                try:
+                    self._ws.send_text_bytes(frame)
+                    return self._recv_correlated(
+                        request_id, json.loads, want_bytes=False
+                    )
+                except (ConnectionError, TimeoutError, OSError):
+                    self._drop_connection()
+                    raise
 
     def send_msg_binary(self, msg_type: str, data: Any = None, **top_level) -> dict:
         """One msgpack-framed event round-trip — the binary twin of
@@ -235,10 +251,17 @@ class GridWSClient:
         )
 
         # framing is checked at call time (under _request's lock, after
-        # connect) — negotiation state doesn't exist before the handshake
+        # connect) — negotiation state doesn't exist before the handshake.
+        # encode runs inside _request's client span, so trace.current()
+        # is the span to stamp into the wire-v2 frame header.
         def encode(msg: Any) -> bytes:
             blob = serialize(msg)
-            return encode_frame(blob, self.wire_codec) if self.wire_v2 else blob
+            if self.wire_v2:
+                return encode_frame(
+                    blob, self.wire_codec,
+                    trace=trace.to_bytes() if self.wire_trace else None,
+                )
+            return blob
 
         def decode(frame: bytes) -> Any:
             return deserialize(decode_frame(frame) if self.wire_v2 else frame)
@@ -255,7 +278,17 @@ class GridWSClient:
                 if self.wire_v2:
                     from pygrid_tpu.serde import decode_frame, encode_frame
 
-                    self._ws.send(encode_frame(blob, self.wire_codec))
+                    with trace.span("client.request", event_type="syft-binary") as tctx:
+                        self._ws.send(
+                            encode_frame(
+                                blob, self.wire_codec,
+                                trace=(
+                                    trace.to_bytes(tctx)
+                                    if self.wire_trace
+                                    else None
+                                ),
+                            )
+                        )
                 else:
                     self._ws.send(blob)
                 while True:

@@ -19,6 +19,7 @@ import requests
 from pygrid_tpu.client.base import GridWSClient
 from pygrid_tpu.plans.state import serialize_model_params, unserialize_model_params
 from pygrid_tpu.serde import deserialize
+from pygrid_tpu.telemetry import trace
 from pygrid_tpu.utils.codes import CYCLE, MODEL_CENTRIC_FL_EVENTS, MSG_FIELD
 from pygrid_tpu.utils.exceptions import PyGridError
 
@@ -42,6 +43,13 @@ class FLJob:
             self.EVENT_REJECTED: [],
             self.EVENT_ERROR: [],
         }
+        #: the job's trace root: every request this job makes (download,
+        #: report — and the training gap between them) shares this
+        #: trace_id, so the node can stitch the whole round into one
+        #: trace (GET /telemetry/cycles/<id> lists it)
+        self.trace_ctx = trace.TraceContext(
+            trace.new_trace_id(), trace.new_span_id()
+        )
         # filled on accept
         self.worker_id: str | None = None
         self.request_key: str | None = None
@@ -66,22 +74,31 @@ class FLJob:
     def start(self, ping: float = 1.0, download: float = 1000.0,
               upload: float = 1000.0) -> None:
         try:
-            auth = self.client.authenticate(
-                self.model_name, self.model_version
-            )
-            if auth.get("error"):
-                raise PyGridError(auth["error"])
-            self.worker_id = auth[MSG_FIELD.WORKER_ID]
-            if auth.get(MSG_FIELD.REQUIRES_SPEED_TEST):
-                ping, download, upload = self.client.speed_test(self.worker_id)
-            cycle = self.client.cycle_request(
-                self.worker_id, self.model_name, self.model_version,
-                ping=ping, download=download, upload=upload,
-            )
-            if cycle.get(CYCLE.STATUS) == CYCLE.ACCEPTED:
-                self.request_key = cycle[CYCLE.KEY]
-                self.client_config = cycle.get(CYCLE.CLIENT_CONFIG) or {}
-                model_id = cycle[MSG_FIELD.MODEL_ID]
+            with trace.use(self.trace_ctx):
+                self._start_traced(ping, download, upload)
+        except Exception as err:  # noqa: BLE001 — event boundary
+            self._emit(self.EVENT_ERROR, err)
+
+    def _start_traced(
+        self, ping: float, download: float, upload: float
+    ) -> None:
+        auth = self.client.authenticate(
+            self.model_name, self.model_version
+        )
+        if auth.get("error"):
+            raise PyGridError(auth["error"])
+        self.worker_id = auth[MSG_FIELD.WORKER_ID]
+        if auth.get(MSG_FIELD.REQUIRES_SPEED_TEST):
+            ping, download, upload = self.client.speed_test(self.worker_id)
+        cycle = self.client.cycle_request(
+            self.worker_id, self.model_name, self.model_version,
+            ping=ping, download=download, upload=upload,
+        )
+        if cycle.get(CYCLE.STATUS) == CYCLE.ACCEPTED:
+            self.request_key = cycle[CYCLE.KEY]
+            self.client_config = cycle.get(CYCLE.CLIENT_CONFIG) or {}
+            model_id = cycle[MSG_FIELD.MODEL_ID]
+            with trace.span("client.download", model=self.model_name):
                 self.model_params = self.client.get_model(
                     self.worker_id,
                     self.request_key,
@@ -94,12 +111,10 @@ class FLJob:
                     )
                     for name, plan_id in (cycle.get(CYCLE.PLANS) or {}).items()
                 }
-                self._emit(self.EVENT_ACCEPTED)
-            else:
-                self.timeout = cycle.get(CYCLE.TIMEOUT)
-                self._emit(self.EVENT_REJECTED, self.timeout)
-        except Exception as err:  # noqa: BLE001 — event boundary
-            self._emit(self.EVENT_ERROR, err)
+            self._emit(self.EVENT_ACCEPTED)
+        else:
+            self.timeout = cycle.get(CYCLE.TIMEOUT)
+            self._emit(self.EVENT_REJECTED, self.timeout)
 
     def report(self, diff_params: list) -> dict:
         """Upload the weight diff (reference fl_events.py report:237-271).
@@ -109,6 +124,11 @@ class FLJob:
         "topk", "fraction": f}`` ships only the top-f fraction of entries
         per tensor, with the dropped remainder carried into this client's
         next report (error feedback — federated/compression.py)."""
+        with trace.use(self.trace_ctx):
+            with trace.span("client.report", model=self.model_name):
+                return self._report_traced(diff_params)
+
+    def _report_traced(self, diff_params: list) -> dict:
         import numpy as np
 
         local_dp = self.client_config.get("local_dp")
@@ -354,7 +374,14 @@ class FLClient:
                 available_codecs()[0] if self.codec == "auto" else self.codec
             )
             params["codec"] = want
-        status, body = self._http.get("/model-centric/get-model", params)
+        # X-PyGrid-Trace ties the HTTP checkpoint download into the same
+        # trace as the WS cycle events (the node's middleware adopts it)
+        hdr = trace.header()
+        status, body = self._http.get(
+            "/model-centric/get-model",
+            params,
+            headers={trace.TRACE_HEADER: hdr} if hdr else None,
+        )
         if status != 200:
             raise PyGridError(body.decode(errors="replace"))
         if self._http.last_headers.get("x-pygrid-wire") == "v2-frame":

@@ -77,7 +77,12 @@ class KeepAliveHTTP:
             self._host, self._port, timeout=self._timeout
         )
 
-    def get(self, path: str, params: dict | None = None) -> tuple[int, bytes]:
+    def get(
+        self,
+        path: str,
+        params: dict | None = None,
+        headers: dict | None = None,
+    ) -> tuple[int, bytes]:
         from urllib.parse import urlencode
 
         if params:
@@ -86,7 +91,7 @@ class KeepAliveHTTP:
             if self._conn is None:
                 self._conn = self._connect()
             try:
-                self._conn.request("GET", path)
+                self._conn.request("GET", path, headers=headers or {})
                 resp = self._conn.getresponse()
                 body = resp.read()
                 self.last_headers = {

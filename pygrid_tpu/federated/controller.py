@@ -248,7 +248,15 @@ class FLController:
 
     # --- reporting ----------------------------------------------------------
 
-    def submit_diff(self, worker_id: str, request_key: str, diff: bytes) -> None:
+    def submit_diff(
+        self,
+        worker_id: str,
+        request_key: str,
+        diff: bytes,
+        wire_codec: str | None = None,
+    ) -> None:
         if not request_key:
             raise E.MissingRequestKeyError()
-        self.cycle_manager.submit_worker_diff(worker_id, request_key, diff)
+        self.cycle_manager.submit_worker_diff(
+            worker_id, request_key, diff, wire_codec=wire_codec
+        )

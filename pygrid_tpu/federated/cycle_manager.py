@@ -21,6 +21,7 @@ the "clients" mesh axis without the arrays ever leaving the chip.
 
 from __future__ import annotations
 
+import contextlib
 import datetime as dt
 import logging
 import threading
@@ -28,6 +29,7 @@ from typing import Any
 
 import numpy as np
 
+from pygrid_tpu import telemetry
 from pygrid_tpu.federated import schemas as S
 from pygrid_tpu.federated import tasks
 from pygrid_tpu.federated.compression import decode_diff
@@ -170,6 +172,9 @@ class CycleManager:
             end=end,
             is_completed=False,
         )
+        telemetry.timeline.cycle_started(
+            cycle.id, fl_process_id=fl_process_id, sequence=sequence
+        )
         if cycle_time:
             self._schedule_deadline(cycle.id, cycle_time)
         return cycle
@@ -249,6 +254,11 @@ class CycleManager:
         request_key: str,
         assigned_checkpoint: int = 0,
     ) -> S.WorkerCycle:
+        tctx = telemetry.trace.current()
+        telemetry.timeline.worker_assigned(
+            cycle.id, worker_id,
+            trace_id=tctx.trace_id if tctx is not None else None,
+        )
         return self._worker_cycles.register(
             cycle_id=cycle.id,
             worker_id=worker_id,
@@ -314,7 +324,7 @@ class CycleManager:
             request_key=request_key,
             columns=(
                 "id", "cycle_id", "worker_id", "request_key",
-                "is_completed", "assigned_checkpoint",
+                "is_completed", "assigned_checkpoint", "started_at",
             ),
         ):
             cycle = self._cycles.first(
@@ -328,8 +338,39 @@ class CycleManager:
                     return cycle, candidate
         raise E.InvalidRequestKeyError()
 
+    def _note_report(
+        self, cycle: S.Cycle, wc: S.WorkerCycle, diff: bytes,
+        wire_codec: str | None,
+    ) -> None:
+        """Telemetry for one accepted report: assign→report latency into
+        the histogram, bytes/codec/trace into the cycle's timeline. Never
+        raises — observability must not fail a report that the protocol
+        already accepted."""
+        try:
+            latency = None
+            started_at = getattr(wc, "started_at", None)
+            if started_at is not None:
+                now = dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+                latency = max(0.0, (now - started_at).total_seconds())
+                telemetry.observe("report_latency_seconds", latency)
+            telemetry.incr(
+                "report_bytes_total", len(diff), codec=wire_codec or "json"
+            )
+            tctx = telemetry.trace.current()
+            telemetry.timeline.worker_report(
+                cycle.id,
+                wc.worker_id,
+                latency_s=latency,
+                n_bytes=len(diff),
+                codec=wire_codec or "json",
+                trace_id=tctx.trace_id if tctx is not None else None,
+            )
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.exception("report telemetry failed")
+
     def submit_worker_diff(
-        self, worker_id: str, request_key: str, diff: bytes
+        self, worker_id: str, request_key: str, diff: bytes,
+        wire_codec: str | None = None,
     ) -> None:
         """Store a worker's diff, then (dedup'd, possibly async) check cycle
         readiness (reference :151-178 + tasks/cycle.py)."""
@@ -345,6 +386,7 @@ class CycleManager:
                 raise E.InvalidRequestKeyError() from None
         if self._async_config(cycle.fl_process_id) is not None:
             self._submit_async(cycle, wc, diff)
+            self._note_report(cycle, wc, diff, wire_codec)
             return
         if not diff:
             # an empty blob must not count toward readiness — completed rows
@@ -368,6 +410,7 @@ class CycleManager:
                     "diff": diff,
                 },
             )
+            self._note_report(cycle, wc, diff, wire_codec)
             tasks.run_task_once(
                 f"complete_cycle_{cycle.id}", self.complete_cycle, cycle.id
             )
@@ -411,6 +454,7 @@ class CycleManager:
                 "diff": diff,
             },
         )
+        self._note_report(cycle, wc, diff, wire_codec)
         if self._uses_fallback_mean(cycle.fl_process_id) and (
             self._robust_config(cycle.fl_process_id) is None
         ):
@@ -553,6 +597,44 @@ class CycleManager:
                 }
             )
         return sorted(out, key=lambda e: e["cycle"])
+
+    # --- telemetry surface --------------------------------------------------
+
+    def cycle_timeline(self, cycle_id: int) -> dict | None:
+        """The round timeline `GET /telemetry/cycles/<id>` serves: the
+        in-memory telemetry record (phases, bytes per codec, traces)
+        merged with the durable worker rows (assign/report timestamps
+        survive a node restart even though the wire detail doesn't).
+        None for a cycle this node has never seen."""
+        cycle = self._cycles.first(id=cycle_id)
+        snap = telemetry.timeline.snapshot(cycle_id)
+        if cycle is None and snap is None:
+            return None
+        if snap is None:
+            snap = {
+                "cycle_id": cycle_id, "phases": {}, "workers": {},
+                "bytes": {}, "traces": [], "assigned": 0, "reported": 0,
+                "stragglers": None, "outcome": None,
+            }
+        if cycle is not None:
+            snap["fl_process_id"] = cycle.fl_process_id
+            snap["sequence"] = cycle.sequence
+            snap["completed"] = bool(cycle.is_completed)
+            snap["started_at"] = (
+                cycle.start.isoformat() if cycle.start else None
+            )
+            rows = self._worker_cycles.query(
+                cycle_id=cycle_id,
+                columns=("worker_id", "started_at", "completed_at"),
+            )
+            snap = telemetry.timeline.merge_db_workers(snap, rows)
+            snap["assigned"] = max(snap.get("assigned") or 0, len(rows))
+        return snap
+
+    def recent_cycles(self, limit: int = 20) -> list[dict]:
+        """Newest-first cycle summaries for `GET /telemetry/cycles` and
+        the dashboard poll."""
+        return telemetry.timeline.recent(limit)
 
     def _decode_and_check(self, diff: bytes, fl_process_id: int) -> list:
         """The one report-validation door (sync + async): non-empty,
@@ -817,13 +899,31 @@ class CycleManager:
 
     # --- the FedAvg core ----------------------------------------------------
 
+    @contextlib.contextmanager
+    def _timed_phase(self, cycle_id: int, name: str = "aggregate"):
+        """``profiling.timed("cycle.aggregate")`` (the /status surface)
+        plus the telemetry twins: the cycle timeline's phase entry and
+        the ``cycle_phase_seconds`` histogram — recorded even when the
+        block returns early or raises."""
+        from pygrid_tpu.utils.profiling import timed
+
+        box = None
+        try:
+            with timed(f"cycle.{name}") as box:
+                yield
+        finally:
+            seconds = (box or {}).get("seconds")
+            if seconds is not None:
+                telemetry.timeline.phase(cycle_id, name, seconds)
+                telemetry.observe(
+                    "cycle_phase_seconds", seconds, phase=name
+                )
+
     def _average_plan_diffs(
         self, process: S.FLProcess, cycle: S.Cycle, server_config: dict
     ) -> None:
         """(reference :219-323) average diffs → new checkpoint → next cycle.
         Timed under ``cycle.aggregate`` (surfaced by /data-centric/status/)."""
-        from pygrid_tpu.utils.profiling import timed
-
         if self.secagg.config_for(process.id) is not None:
             # masked sums cannot be averaged yet — hand the cycle to the
             # SecAgg unmask round; it calls back finish_secagg_cycle /
@@ -839,7 +939,7 @@ class CycleManager:
             # diff + assigned_checkpoint recover payload and staleness
             # (weights recompute against the CURRENT latest checkpoint,
             # which only discounts survivors of a restart further).
-            with timed("cycle.aggregate"):
+            with self._timed_phase(cycle.id):
                 with self._accum_lock:
                     rows = self._async_buffered(process.id)
                     acc = self._async_accum.pop(process.id, None)
@@ -880,7 +980,7 @@ class CycleManager:
                 )
             return
 
-        with timed("cycle.aggregate"):
+        with self._timed_phase(cycle.id):
             if not self._worker_cycles.contains(
                 cycle_id=cycle.id, is_completed=True
             ):
@@ -1004,9 +1104,7 @@ class CycleManager:
         if context is None:
             return
         cycle, process, server_config = context
-        from pygrid_tpu.utils.profiling import timed
-
-        with timed("cycle.aggregate"):
+        with self._timed_phase(cycle.id):
             model = self.model_manager.get(fl_process_id=process.id)
             ckpt = self.model_manager.load(model_id=model.id, alias="latest")
             params = unserialize_model_params(ckpt.value)
@@ -1064,6 +1162,23 @@ class CycleManager:
             timer.cancel()
         with self._accum_lock:
             self._accum.pop(cycle.id, None)
+        assigned = self._worker_cycles.count(cycle_id=cycle.id)
+        reported = self._worker_cycles.count(
+            cycle_id=cycle.id, is_completed=True
+        )
+        outcome = "aggregated" if reported else "empty"
+        telemetry.timeline.cycle_closed(
+            cycle.id, assigned=assigned, reported=reported, outcome=outcome
+        )
+        telemetry.incr("cycles_completed_total", 1, outcome=outcome)
+        telemetry.record(
+            "cycle.closed",
+            cycle_id=cycle.id,
+            fl_process_id=process.id,
+            sequence=cycle.sequence,
+            assigned=assigned,
+            reported=reported,
+        )
 
         num_cycles = server_config.get("num_cycles")
         if num_cycles is not None and cycle.sequence >= num_cycles:

@@ -214,6 +214,10 @@ def generate(
             f"max_len ({cfg.max_len})"
         )
     temp_is_static = isinstance(temperature, (int, float))
+    if temp_is_static and temperature < 0.0:
+        # the traced path clamps negatives to greedy; the static path
+        # would sample the LEAST likely tokens — reject instead
+        raise ValueError("temperature must be >= 0")
     if temp_is_static and temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
     if not temp_is_static and key is None:
@@ -232,8 +236,21 @@ def generate(
     def pick(logits, k):
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1
+        if temp_is_static:
+            # static temperature is validated >= 0 at entry (== 0 is the
+            # greedy branch), so the divide is safe here
+            return jax.random.categorical(
+                k, logits / temperature, axis=-1
+            ).astype(prompt.dtype)
+        # traced temperature: a runtime zero must fall back to greedy —
+        # logits / 0 is NaN logits and categorical over NaN returns
+        # arbitrary tokens; the guard keeps one compiled program serving
+        # every temperature INCLUDING zero
+        t = jnp.asarray(temperature, jnp.float32)
+        safe_t = jnp.where(t > 0.0, t, jnp.float32(1.0))
+        sampled = jax.random.categorical(k, logits / safe_t, axis=-1)
+        return jnp.where(
+            t > 0.0, sampled, jnp.argmax(logits, axis=-1)
         ).astype(prompt.dtype)
 
     keys = (

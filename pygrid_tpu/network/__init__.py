@@ -57,6 +57,7 @@ def create_app(
 ):
     from aiohttp import web
 
+    from pygrid_tpu import telemetry
     from pygrid_tpu.network import routes as R
     from pygrid_tpu.network.ws import ws_handler
 
@@ -67,7 +68,7 @@ def create_app(
         n_replica=n_replica,
         monitor_interval=monitor_interval,
     )
-    app = web.Application()
+    app = web.Application(middlewares=[telemetry.http_middleware()])
     app["network"] = ctx
     app.router.add_get("/", ws_handler)
     R.register(app)

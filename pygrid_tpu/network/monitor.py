@@ -16,6 +16,8 @@ import logging
 import time
 from typing import Any
 
+from pygrid_tpu import telemetry
+
 logger = logging.getLogger(__name__)
 
 PING_THRESHOLD_MS = 5000.0  # reference WORKER_PROPERTIES.PING_THRESHOLD
@@ -61,6 +63,13 @@ class NodeProxy:
             self.ping = (time.monotonic() - self._monitor_sent_at) * 1000
             self._monitor_sent_at = None  # a duplicate answer must not
             # recompute ping from this consumed timestamp
+            telemetry.observe(
+                "heartbeat_rtt_seconds", self.ping / 1000.0,
+                transport="ws", node=self.id,
+            )
+            telemetry.incr(
+                "monitor_polls_total", 1, outcome="online", node=self.id
+            )
         self.last_seen = time.time()
         self.connected_nodes = message.get("nodes") or []
         self.hosted_models = message.get("models") or []
@@ -72,10 +81,14 @@ class NodeProxy:
 
 
 async def poll_node(proxy: NodeProxy) -> None:
-    """HTTP fallback heartbeat: status + models + dataset tags."""
+    """HTTP fallback heartbeat: status + models + dataset tags. Exactly
+    ONE ``monitor_polls_total`` sample per poll, decided by how the whole
+    sweep ended — a 200 on /status followed by a failing /models fetch is
+    one offline poll, not one of each."""
     import aiohttp
 
     t0 = time.monotonic()
+    outcome = "offline"
     try:
         timeout = aiohttp.ClientTimeout(total=5)
         async with aiohttp.ClientSession(timeout=timeout) as session:
@@ -98,8 +111,17 @@ async def poll_node(proxy: NodeProxy) -> None:
                 proxy.address + "/data-centric/dataset-tags"
             ) as resp:
                 proxy.hosted_datasets = await resp.json()
+        outcome = "online"
+        telemetry.observe(
+            "heartbeat_rtt_seconds", proxy.ping / 1000.0,
+            transport="http", node=proxy.id,
+        )
     except Exception:  # noqa: BLE001 — unreachable node is a data point
         proxy.mark_offline()
+    finally:
+        telemetry.incr(
+            "monitor_polls_total", 1, outcome=outcome, node=proxy.id
+        )
 
 
 async def monitor_loop(ctx) -> None:

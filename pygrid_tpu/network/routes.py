@@ -86,6 +86,7 @@ async def metrics(request: web.Request) -> web.Response:
     the node app serves its own /metrics; the reference has neither
     (SURVEY §5.5)."""
     ctx = _ctx(request)
+    from pygrid_tpu import telemetry
     from pygrid_tpu.utils.metrics import Exposition
 
     exp = Exposition()
@@ -98,6 +99,9 @@ async def metrics(request: web.Request) -> web.Response:
     for status in ("online", "busy", "offline"):
         exp.gauge("grid_nodes", by_status.get(status, 0),
                   "nodes by monitor status", {"status": status})
+    # the telemetry bus: request latency by route, heartbeat RTT by
+    # transport, monitor poll outcomes, event counters
+    telemetry.export(exp)
     return web.Response(
         text=exp.render(), content_type="text/plain", charset="utf-8"
     )

@@ -119,7 +119,12 @@ def create_app(
         num_replicas=num_replicas,
         strict_crypto_store=strict_crypto_store,
     )
-    app = web.Application(client_max_size=256 * 1024 * 1024)
+    from pygrid_tpu import telemetry
+
+    app = web.Application(
+        client_max_size=256 * 1024 * 1024,
+        middlewares=[telemetry.http_middleware()],
+    )
     app["node"] = ctx
     app.router.add_get("/", ws_handler)  # WS upgrade or landing JSON
     R.register(app)

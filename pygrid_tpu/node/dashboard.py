@@ -41,6 +41,11 @@ PAGE = """<!doctype html>
 <table id="models"><thead>
 <tr><th>id</th><th>download</th><th>remote inference</th><th>mpc</th></tr>
 </thead><tbody></tbody></table>
+<h2>Recent cycles</h2>
+<table id="cycles"><thead>
+<tr><th>cycle</th><th>seq</th><th>reports</th><th>stragglers</th>
+<th>aggregate (ms)</th><th>outcome</th></tr>
+</thead><tbody></tbody></table>
 <script>
 function row(fields) {{
   const tr = document.createElement('tr');
@@ -87,6 +92,25 @@ async function refresh() {{
         p.cycles_completed + '/' + p.cycles_total,
         'loss' in m ? m.loss.toFixed(4) : '—',
         'acc' in m ? m.acc.toFixed(4) : '—']));
+    }}
+    const tl = await (await fetch('/telemetry/cycles')).json();
+    const cyBody = document.querySelector('#cycles tbody');
+    cyBody.replaceChildren();
+    const cycles = tl.cycles || [];
+    if (!cycles.length) {{
+      const tr = document.createElement('tr');
+      const td = document.createElement('td');
+      td.colSpan = 6; td.className = 'muted'; td.textContent = 'none';
+      tr.appendChild(td); cyBody.appendChild(tr);
+    }}
+    for (const c of cycles) {{
+      const agg = (c.phases || {{}}).aggregate;
+      cyBody.appendChild(row([
+        c.cycle_id, c.sequence ?? '—',
+        c.reported + '/' + c.assigned,
+        c.stragglers ?? '—',
+        agg !== undefined ? (agg * 1000).toFixed(1) : '—',
+        c.outcome || 'open']));
     }}
   }} catch (err) {{
     document.getElementById('status').textContent = 'error: ' + err;

@@ -18,9 +18,11 @@ import os
 import uuid
 from typing import Any, Callable
 
+from pygrid_tpu import telemetry
 from pygrid_tpu.datacentric.object_storage import recover_objects
 from pygrid_tpu.federated.auth import verify_token
 from pygrid_tpu.node import NodeContext, __version__
+from pygrid_tpu.telemetry import trace
 from pygrid_tpu.node.sockets import SocketHandler
 from pygrid_tpu.serde import deserialize, serialize
 from pygrid_tpu.users.events import USER_HANDLERS
@@ -62,12 +64,27 @@ class Connection:
         #: blob cache), so the per-frame codec pass would be K-per-round
         #: wasted work — skip it for THIS response only
         self.suppress_frame_codec: bool = False
+        #: one-shot trace context extracted from a wire-v2 frame header
+        #: by the WS endpoint (consumed by route_requests); and the span
+        #: this connection served the current message under (set by
+        #: route_requests, consumed by the WS endpoint for the response
+        #: frame's trace header)
+        self.incoming_trace = None
+        self.last_trace = None
 
     @property
     def worker(self):
         if self.session is None:
             raise E.AuthorizationError("authentication required")
         return self.session.worker
+
+    def codec_label(self) -> str:
+        """The wire-codec label telemetry attributes this message's
+        payload bytes to — one definition so the download and report
+        counters can never disagree."""
+        if self.binary_frame and self.wire_codec:
+            return self.wire_codec
+        return "binary" if self.binary_frame else "json"
 
 
 # ── model-centric FL events (reference fl_events.py) ─────────────────────────
@@ -246,6 +263,9 @@ def get_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
             blob = ctx.fl.model_manager.load_encoded(
                 model_id, precision=data.get("precision")
             )
+        codec = conn.codec_label()
+        telemetry.timeline.add_bytes(cycle.id, "download", codec, len(blob))
+        telemetry.incr("model_download_bytes_total", len(blob), codec=codec)
         response[CYCLE.STATUS] = SUCCESS
         response[MSG_FIELD.MODEL] = (
             blob if conn.binary_frame else base64.b64encode(blob).decode()
@@ -276,7 +296,8 @@ def report(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         else:
             diff = raw if isinstance(raw, bytes) else bytes(raw)
         ctx.fl.submit_diff(
-            data.get(MSG_FIELD.WORKER_ID), data.get(CYCLE.KEY), diff
+            data.get(MSG_FIELD.WORKER_ID), data.get(CYCLE.KEY), diff,
+            wire_codec=conn.codec_label(),
         )
         response[CYCLE.STATUS] = SUCCESS
     except Exception as err:  # noqa: BLE001 — protocol boundary
@@ -499,14 +520,21 @@ _GENERATION_JIT: dict = {}
 
 def _generation_fn(cfg, n_new: int, seeded: bool):
     cache_key = (tuple(cfg), n_new, seeded)
-    fn = _GENERATION_JIT.get(cache_key)
+    fn = _GENERATION_JIT.pop(cache_key, None)
+    if fn is not None:
+        # LRU touch: re-insert at the back so hot programs survive a
+        # client cycling n_new values (dicts iterate insertion-ordered)
+        _GENERATION_JIT[cache_key] = fn
     if fn is None:
         import jax
 
         from pygrid_tpu.models import decode
 
         if len(_GENERATION_JIT) >= 64:
-            _GENERATION_JIT.clear()
+            # evict only the single least-recently-used entry — clearing
+            # the whole dict let one hostile client flush every hot
+            # compiled program for all models at once
+            _GENERATION_JIT.pop(next(iter(_GENERATION_JIT)))
         if seeded:
             fn = jax.jit(
                 lambda p, x, k, temp: decode.generate(
@@ -580,11 +608,26 @@ def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
         n_new = int(message.get("n_new", 16))
         if n_new < 1:
             return {SUCCESS: False, ERROR: "n_new must be >= 1"}
+        import math
+
         temperature = float(message.get("temperature", 0.0))
-        # `== 0 or > 0` rejects both negatives AND NaN (NaN fails both)
-        if not (temperature == 0.0 or temperature > 0.0):
-            return {SUCCESS: False, ERROR: "temperature must be >= 0"}
+        # `== 0 or > 0` rejects both negatives AND NaN (NaN fails both);
+        # isfinite rejects Infinity, which would otherwise collapse the
+        # logits to zero and silently serve uniform-random tokens
+        if not math.isfinite(temperature) or not (
+            temperature == 0.0 or temperature > 0.0
+        ):
+            return {SUCCESS: False, ERROR: "temperature must be finite and >= 0"}
         seed = message.get("seed")
+        if seed is not None:
+            seed = int(seed)
+            # PRNGKey overflows int64 with an uncaught OverflowError —
+            # bound the client-supplied value to the typed-error contract
+            if not 0 <= seed < 2**63:
+                return {
+                    SUCCESS: False,
+                    ERROR: "seed must be in [0, 2**63)",
+                }
 
         import jax
         import jax.numpy as jnp
@@ -709,6 +752,41 @@ def _handler_of(ctx: NodeContext) -> SocketHandler:
     return _socket_handlers.setdefault(id(ctx), SocketHandler())
 
 
+def _incoming_trace(conn: Connection, parsed: Any):
+    """The message's trace context: the wire-v2 frame header (one-shot,
+    set by the WS endpoint) wins; legacy framing carries a ``trace``
+    field on the envelope; absence means the server synthesizes a root
+    (``trace.serve``) so a legacy client's cycle is still traced."""
+    incoming, conn.incoming_trace = conn.incoming_trace, None
+    if incoming is None and isinstance(parsed, dict):
+        incoming = trace.parse_header(parsed.get("trace"))
+    return incoming
+
+
+def _traced_call(conn: Connection, parsed: Any, event: str, fn):
+    """Dispatch one event under a served span: adopts (or synthesizes)
+    the trace, records the handler span + latency histogram, and leaves
+    the span on ``conn.last_trace`` for the response frame's header."""
+    import time
+
+    incoming = _incoming_trace(conn, parsed)
+    t0 = time.perf_counter()
+    with trace.serve(incoming) as tctx:
+        conn.last_trace = tctx
+        result = fn()
+    dt = time.perf_counter() - t0
+    telemetry.observe("node_event_seconds", dt, event=event)
+    telemetry.record(
+        "node.event",
+        name=event,
+        trace_id=tctx.trace_id,
+        span_id=tctx.span_id,
+        parent_id=incoming.span_id if incoming is not None else None,
+        duration_s=dt,
+    )
+    return result
+
+
 def route_requests(
     ctx: NodeContext, message: str | bytes | bytearray, conn: Connection
 ):
@@ -726,17 +804,30 @@ def route_requests(
             try:
                 parsed = deserialize(message)
             except Exception:  # noqa: BLE001 — let the worker frame the error
-                return forward_binary_message(ctx, message, conn)
+                return _traced_call(
+                    conn, None, "syft-binary",
+                    lambda: forward_binary_message(ctx, message, conn),
+                )
             if isinstance(parsed, dict) and parsed.get(MSG_FIELD.TYPE) in ROUTES:
                 request_id = parsed.get(MSG_FIELD.REQUEST_ID)
-                try:
-                    response = ROUTES[parsed[MSG_FIELD.TYPE]](ctx, parsed, conn)
-                except Exception as err:  # noqa: BLE001 — protocol boundary
-                    response = {ERROR: str(err)}
+                event = parsed[MSG_FIELD.TYPE]
+
+                def _dispatch():
+                    try:
+                        return ROUTES[event](ctx, parsed, conn)
+                    except Exception as err:  # noqa: BLE001 — protocol boundary
+                        return {ERROR: str(err)}
+
+                response = _traced_call(conn, parsed, event, _dispatch)
                 if request_id:
                     response[MSG_FIELD.REQUEST_ID] = request_id
                 return serialize(response)
-            return forward_binary_message(ctx, message, conn, decoded=parsed)
+            return _traced_call(
+                conn, parsed, "syft-binary",
+                lambda: forward_binary_message(
+                    ctx, message, conn, decoded=parsed
+                ),
+            )
         finally:
             conn.binary_frame = False
 
@@ -744,8 +835,16 @@ def route_requests(
     try:
         parsed = json.loads(message)
         request_id = parsed.get(MSG_FIELD.REQUEST_ID)
-        handler = ROUTES[parsed[MSG_FIELD.TYPE]]
-        response = handler(ctx, parsed, conn)
+        event = parsed[MSG_FIELD.TYPE]
+        handler = ROUTES[event]
+
+        def _dispatch_json():
+            try:
+                return handler(ctx, parsed, conn)
+            except Exception as err:  # noqa: BLE001 — protocol boundary
+                return {ERROR: str(err)}
+
+        response = _traced_call(conn, parsed, event, _dispatch_json)
     except Exception as err:  # noqa: BLE001 — protocol boundary
         response = {ERROR: str(err)}
     if request_id:

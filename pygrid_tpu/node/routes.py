@@ -18,6 +18,7 @@ from typing import Any
 
 from aiohttp import web
 
+from pygrid_tpu import telemetry
 from pygrid_tpu.node import NodeContext, __version__
 from pygrid_tpu.node.events import (
     Connection,
@@ -148,11 +149,13 @@ def _require_query(request: web.Request, *names: str) -> list[str]:
 
 def _validated_cycle(ctx: NodeContext, request: web.Request, fl_process_id: int):
     """request_key gate shared by the three download routes
-    (reference routes.py:163-250)."""
+    (reference routes.py:163-250). Returns the validated cycle so
+    callers can attribute wire bytes to its timeline."""
     worker_id, request_key = _require_query(request, "worker_id", "request_key")
     cycle = ctx.fl.cycle_manager.last(fl_process_id)
     worker = ctx.fl.worker_manager.get(id=worker_id)
     ctx.fl.cycle_manager.validate(worker.id, cycle.id, request_key)
+    return cycle
 
 
 async def mc_get_model(request: web.Request) -> web.Response:
@@ -160,7 +163,7 @@ async def mc_get_model(request: web.Request) -> web.Response:
     try:
         model_id = int(_require_query(request, "model_id")[0])
         model = ctx.fl.model_manager.get(id=model_id)
-        _validated_cycle(ctx, request, model.fl_process_id)
+        cycle = _validated_cycle(ctx, request, model.fl_process_id)
         # ?codec=zlib|zstd → the wire-v2 frame envelope, compressed once
         # per checkpoint (blob cache) and unwrapped client-side with
         # decode_frame. The response header is the client's only signal —
@@ -172,6 +175,12 @@ async def mc_get_model(request: web.Request) -> web.Response:
         codec = codec if codec in available_codecs() else None
         blob = ctx.fl.model_manager.load_encoded(
             model_id, precision=request.query.get("precision"), codec=codec
+        )
+        telemetry.timeline.add_bytes(
+            cycle.id, "download", codec or "http", len(blob)
+        )
+        telemetry.incr(
+            "model_download_bytes_total", len(blob), codec=codec or "http"
         )
         headers = {"X-PyGrid-Wire": "v2-frame"} if codec else {}
         return web.Response(
@@ -341,6 +350,58 @@ async def mc_retrieve_model(request: web.Request) -> web.Response:
         return _json_error(err, _status_for(err))
 
 
+# ── telemetry ────────────────────────────────────────────────────────────────
+
+
+async def telemetry_cycles(request: web.Request) -> web.Response:
+    """Newest-first summaries of recent FL cycles (phase durations,
+    report counts, stragglers) — the dashboard's poll and the operator's
+    index into the per-cycle detail route."""
+    ctx = _ctx(request)
+    try:
+        limit = int(request.query.get("limit", 20))
+    except ValueError as err:
+        return _json_error(err, 400)
+    return web.json_response(
+        {"cycles": ctx.fl.cycle_manager.recent_cycles(max(1, limit))}
+    )
+
+
+async def telemetry_cycle_detail(request: web.Request) -> web.Response:
+    """One cycle's full round timeline: per-phase durations, per-worker
+    report latency/bytes/codec, wire bytes per codec, the trace ids that
+    stitch it to client spans, and straggler counts."""
+    ctx = _ctx(request)
+    try:
+        cycle_id = int(request.match_info["id"])
+    except ValueError as err:
+        return _json_error(err, 400)
+    snap = ctx.fl.cycle_manager.cycle_timeline(cycle_id)
+    if snap is None:
+        return web.json_response(
+            {"error": f"unknown cycle {cycle_id}"}, status=404
+        )
+    return web.json_response(snap)
+
+
+async def telemetry_events(request: web.Request) -> web.Response:
+    """The ring buffer's most recent structured events (spans included) —
+    the low-tech trace viewer: filter by ?event= and ?trace_id=."""
+    try:
+        limit = int(request.query.get("limit", 200))
+    except ValueError as err:
+        return _json_error(err, 400)
+    # filter BEFORE the tail-limit: a trace's spans must be findable even
+    # when newer unrelated events have pushed them past `limit`
+    events = telemetry.events(event=request.query.get("event"))
+    trace_id = request.query.get("trace_id")
+    if trace_id:
+        events = [e for e in events if e.get("trace_id") == trace_id]
+    return web.json_response(
+        {"events": events[-max(1, min(limit, 2048)):]}
+    )
+
+
 # ── data-centric ─────────────────────────────────────────────────────────────
 
 
@@ -428,6 +489,10 @@ async def metrics(request: web.Request) -> web.Response:
                     "cumulative seconds per timed section", labels)
         exp.counter("timing_invocations_total", rec["count"],
                     "invocations per timed section", labels)
+    # the telemetry bus: event counters + every histogram family
+    # (request latency by route, frame decode time, report latency,
+    # cycle phases, wire bytes by codec, serde tensor copies)
+    telemetry.export(exp)
     return web.Response(
         text=exp.render(), content_type="text/plain", charset="utf-8"
     )
@@ -611,6 +676,10 @@ def register(app: web.Application) -> None:
     r.add_get("/data-centric/detailed-models-list/", dc_detailed_models)
     r.add_get("/data-centric/identity/", dc_identity)
     r.add_get("/metrics", metrics)
+    # telemetry (no reference analog — SURVEY §5.1: stdlib logging only)
+    r.add_get("/telemetry/cycles", telemetry_cycles)
+    r.add_get("/telemetry/cycles/{id}", telemetry_cycle_detail)
+    r.add_get("/telemetry/events", telemetry_events)
     r.add_get("/data-centric/status/", dc_status)
     r.add_get("/data-centric/workers/", dc_workers)
     r.add_post("/data-centric/serve-model/", dc_serve_model)

@@ -19,17 +19,21 @@ configuration.
 from __future__ import annotations
 
 import asyncio
+import time
 
 from aiohttp import WSMsgType, web
 
+from pygrid_tpu import telemetry
 from pygrid_tpu.node.events import Connection, _handler_of, route_requests
 from pygrid_tpu.serde import (
-    decode_frame,
+    decode_frame_traced,
     encode_frame,
     offered_subprotocols,
     serialize,
     subprotocol_codec,
+    subprotocol_traced,
 )
+from pygrid_tpu.telemetry import trace
 
 #: every subprotocol variant this build can serve — aiohttp picks the
 #: first of the client's offers present here (client preference wins)
@@ -58,30 +62,62 @@ async def ws_handler(request: web.Request) -> web.StreamResponse:
     await ws.prepare(request)
     conn = Connection(ctx, socket=ws)
     conn.wire_v2, conn.wire_codec = subprotocol_codec(ws.ws_protocol)
+    #: trace headers on frames ONLY when the peer negotiated the
+    #: ``.trace`` subprotocol variant — a plain-v2 peer's decoder
+    #: predates the tag bit and would reject it
+    wire_trace = subprotocol_traced(ws.ws_protocol)
     loop = asyncio.get_running_loop()
-    def _process(payload):
-        """Unframe → route → frame, all ON THE EXECUTOR THREAD: per-frame
-        decompression/compression of megabyte payloads must not stall the
-        event loop any more than the handlers themselves."""
+    codec_label = conn.wire_codec or ("v2" if conn.wire_v2 else "v1")
+
+    def _unframe_route_frame(payload):
         if conn.wire_v2 and not isinstance(payload, str):
+            t0 = time.perf_counter()
             try:
-                payload = decode_frame(payload)
+                payload, frame_trace = decode_frame_traced(payload)
             except ValueError as err:
                 # a bad frame on a negotiated connection is a peer bug —
                 # answer typed, keep the socket alive
                 return encode_frame(
                     serialize({"error": f"bad wire-v2 frame: {err}"})
                 )
+            telemetry.observe(
+                "ws_frame_decode_seconds", time.perf_counter() - t0
+            )
+            # one-shot: route_requests consumes it for the handler span
+            conn.incoming_trace = trace.from_bytes(frame_trace)
         response = route_requests(ctx, payload, conn)
         # one-shot handler hint: a response embedding an already-
         # compressed payload (cached checkpoint) skips the envelope
         # codec pass — it would be redundant work per worker
         suppress, conn.suppress_frame_codec = conn.suppress_frame_codec, False
+        served, conn.last_trace = conn.last_trace, None
         if conn.wire_v2 and isinstance(
             response, (bytes, bytearray, memoryview)
         ):
             codec = None if suppress else conn.wire_codec
-            response = encode_frame(bytes(response), codec)
+            response = encode_frame(
+                bytes(response), codec,
+                trace=trace.to_bytes(served) if wire_trace else None,
+            )
+        return response
+
+    def _process(payload):
+        """Unframe → route → frame, all ON THE EXECUTOR THREAD: per-frame
+        decompression/compression of megabyte payloads must not stall the
+        event loop any more than the handlers themselves. (Byte counters:
+        TEXT frames count characters — the JSON protocol is ASCII apart
+        from user-supplied strings, so the drift is negligible and the
+        alternative is re-encoding megabyte report frames.)"""
+        telemetry.incr(
+            "wire_bytes_total", len(payload), direction="in",
+            codec=codec_label,
+        )
+        response = _unframe_route_frame(payload)
+        if response is not None:
+            telemetry.incr(
+                "wire_bytes_total", len(response), direction="out",
+                codec=codec_label,
+            )
         return response
 
     try:

@@ -557,11 +557,23 @@ def from_hex(hexstr: str) -> Any:
 
 #: the subprotocol token; a negotiated codec appends ``+zstd`` / ``+zlib``
 WS_SUBPROTOCOL_V2 = "pygrid.wire.v2"
+#: the trace-capable variant: frames MAY carry the 0x80 trace-header tag
+#: bit. A separate token because the bit is a frame-format extension —
+#: a peer that negotiated plain v2 must never receive it (its decoder
+#: predates the flag and would reject the tag byte).
+WS_SUBPROTOCOL_V2_TRACE = WS_SUBPROTOCOL_V2 + ".trace"
 
 FRAME_RAW = 0x00
 FRAME_ZLIB = 0x01
 FRAME_ZSTD = 0x02
 _CODEC_TAGS = {"zlib": FRAME_ZLIB, "zstd": FRAME_ZSTD}
+
+#: tag high bit: a trace-context header (16-byte trace id + 8-byte span
+#: id) sits between the tag byte and the payload. Orthogonal to the
+#: codec in the low bits; a frame without the bit is byte-identical to
+#: the PR-1 format, so untraced peers interoperate unchanged.
+FRAME_TRACE_FLAG = 0x80
+TRACE_HEADER_BYTES = 24
 
 try:  # optional dependency — the container may not ship it
     import zstandard as _zstd
@@ -585,45 +597,74 @@ def available_codecs() -> tuple[str, ...]:
 
 
 def offered_subprotocols(codec: str | None = "auto") -> list[str]:
-    """Client-side offer list, preference-ordered (compressed variants
-    first, plain v2 last so a codec-less server still negotiates v2).
-    ``codec=None`` offers plain v2 only; ``"auto"`` offers everything this
-    build supports."""
+    """Client-side offer list, preference-ordered: trace-capable variants
+    first (compressed before plain), then the same ladder without trace,
+    plain v2 last — so a codec-less or trace-less server still negotiates
+    the best framing it knows. ``codec=None`` offers no compression;
+    ``"auto"`` offers everything this build supports."""
     if codec == "auto":
-        offers = [f"{WS_SUBPROTOCOL_V2}+{c}" for c in available_codecs()]
+        with_codec = [f"+{c}" for c in available_codecs()]
     elif codec:
         if codec not in available_codecs():
             raise ValueError(
                 f"codec {codec!r} not available (have {available_codecs()})"
             )
-        offers = [f"{WS_SUBPROTOCOL_V2}+{codec}"]
+        with_codec = [f"+{codec}"]
     else:
-        offers = []
-    return offers + [WS_SUBPROTOCOL_V2]
+        with_codec = []
+    suffixes = with_codec + [""]
+    return [f"{WS_SUBPROTOCOL_V2_TRACE}{s}" for s in suffixes] + [
+        f"{WS_SUBPROTOCOL_V2}{s}" for s in suffixes
+    ]
 
 
 def subprotocol_codec(proto: str | None) -> tuple[bool, str | None]:
     """``(v2_negotiated, codec)`` from the handshake's selected
-    subprotocol. Anything unrecognized — including a ``+codec`` suffix
-    this build can't run — degrades to not-negotiated, never an error:
-    the legacy framing always works."""
-    if not proto or not str(proto).startswith(WS_SUBPROTOCOL_V2):
+    subprotocol (trace-capable variants included). Anything unrecognized
+    — including a ``+codec`` suffix this build can't run — degrades to
+    not-negotiated, never an error: the legacy framing always works."""
+    if not proto:
         return False, None
-    if proto == WS_SUBPROTOCOL_V2:
-        return True, None
-    suffix = str(proto)[len(WS_SUBPROTOCOL_V2):]
-    if suffix.startswith("+"):
-        codec = suffix[1:]
-        if codec in available_codecs():
-            return True, codec
+    proto = str(proto)
+    for base in (WS_SUBPROTOCOL_V2_TRACE, WS_SUBPROTOCOL_V2):
+        if proto == base:
+            return True, None
+        if proto.startswith(base + "+"):
+            codec = proto[len(base) + 1:]
+            if codec in available_codecs():
+                return True, codec
+            return False, None
     return False, None
 
 
-def encode_frame(payload: bytes, codec: str | None = None) -> bytes:
+def subprotocol_traced(proto: str | None) -> bool:
+    """Whether the negotiated subprotocol permits the 0x80 trace-header
+    tag bit on binary frames (both directions)."""
+    if not proto or not str(proto).startswith(WS_SUBPROTOCOL_V2_TRACE):
+        return False
+    return subprotocol_codec(proto)[0]
+
+
+def encode_frame(
+    payload: bytes, codec: str | None = None, trace: bytes | None = None
+) -> bytes:
     """Wrap a serde payload for a v2 connection: one codec tag byte, then
     the (possibly compressed) payload. Compression is per-frame and only
     kept when it actually wins — high-entropy float payloads commonly
-    don't shrink, and shipping them raw costs one tag byte."""
+    don't shrink, and shipping them raw costs one tag byte.
+
+    ``trace``: an optional :data:`TRACE_HEADER_BYTES` trace-context
+    header (``telemetry.trace.to_bytes``) carried between the tag byte
+    and the payload, flagged by the tag's high bit."""
+    head = b""
+    flag = 0
+    if trace is not None:
+        if len(trace) != TRACE_HEADER_BYTES:
+            raise ValueError(
+                f"trace header must be {TRACE_HEADER_BYTES} bytes"
+            )
+        head = bytes(trace)
+        flag = FRAME_TRACE_FLAG
     if codec and len(payload) >= MIN_COMPRESS_BYTES:
         if codec == "zstd" and _zstd is not None:
             packed = _zstd.ZstdCompressor(level=3).compress(bytes(payload))
@@ -634,21 +675,37 @@ def encode_frame(payload: bytes, codec: str | None = None) -> bytes:
         else:
             raise ValueError(f"unknown frame codec {codec!r}")
         if len(packed) < len(payload):
-            return bytes((tag,)) + packed
-    return b"\x00" + bytes(payload)
+            return bytes((tag | flag,)) + head + packed
+    return bytes((FRAME_RAW | flag,)) + head + bytes(payload)
 
 
 def decode_frame(frame: bytes | bytearray | memoryview) -> Any:
-    """Unwrap a v2 binary frame → the serde payload. Raw frames return a
-    zero-copy memoryview into ``frame``; compressed frames return fresh
-    bytes, output-capped so a hostile frame can't balloon node memory."""
+    """Unwrap a v2 binary frame → the serde payload (any trace header is
+    skipped; use :func:`decode_frame_traced` to keep it)."""
+    return decode_frame_traced(frame)[0]
+
+
+def decode_frame_traced(
+    frame: bytes | bytearray | memoryview,
+) -> tuple[Any, bytes | None]:
+    """Unwrap a v2 binary frame → ``(payload, trace_header_or_None)``.
+    Raw frames return a zero-copy memoryview into ``frame``; compressed
+    frames return fresh bytes, output-capped so a hostile frame can't
+    balloon node memory."""
     view = memoryview(frame)
     if len(view) < 1:
         raise ValueError("empty wire-v2 frame")
     tag = view[0]
+    trace = None
     body = view[1:]
+    if tag & FRAME_TRACE_FLAG:
+        tag &= ~FRAME_TRACE_FLAG
+        if len(view) < 1 + TRACE_HEADER_BYTES:
+            raise ValueError("wire-v2 frame truncates its trace header")
+        trace = bytes(view[1 : 1 + TRACE_HEADER_BYTES])
+        body = view[1 + TRACE_HEADER_BYTES :]
     if tag == FRAME_RAW:
-        return body
+        return body, trace
     if tag == FRAME_ZLIB:
         d = zlib.decompressobj()
         try:
@@ -661,13 +718,16 @@ def decode_frame(frame: bytes | bytearray | memoryview) -> Any:
             # a truncated-but-valid prefix decompresses without raising —
             # partial payload must be a typed error, not garbage msgpack
             raise ValueError("bad zlib frame: truncated or trailing bytes")
-        return out
+        return out, trace
     if tag == FRAME_ZSTD:
         if _zstd is None:
             raise ValueError("zstd frame received but zstandard not installed")
         try:
-            return _zstd.ZstdDecompressor().decompress(
-                bytes(body), max_output_size=MAX_DECOMPRESSED_BYTES
+            return (
+                _zstd.ZstdDecompressor().decompress(
+                    bytes(body), max_output_size=MAX_DECOMPRESSED_BYTES
+                ),
+                trace,
             )
         except _zstd.ZstdError as err:
             raise ValueError(f"bad zstd frame: {err}") from err

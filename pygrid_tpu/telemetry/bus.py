@@ -1,0 +1,179 @@
+"""The process-wide telemetry event bus.
+
+Always on, by design: there is no enable flag to forget in production,
+so every code path pays the bus's cost on every call — which is why the
+implementation is deliberately boring. One small lock held for a few
+dict/list operations per call (no I/O, no allocation beyond the event
+dict itself), a bounded ring for structured events, plain integer
+counters, and fixed-bucket histograms. The budget is enforced by
+``bench.bench_telemetry_overhead``: the instrumented wire round must
+stay within 2% of the bare PR-1 path.
+
+Histograms use **log-linear buckets**: a 1 / 2.5 / 5 ladder per decade
+(the classic SRE latency ladder), spanning 1µs to 500s by default. Log
+spacing keeps the bucket count small across nine decades; the linear
+subdivision inside each decade keeps quantile estimates honest where
+latencies actually cluster.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from typing import Any, Iterable
+
+#: structured events kept in memory (oldest evicted first)
+RING_SIZE = 4096
+
+
+def log_linear_bounds(
+    lo_exp: int = -6,
+    hi_exp: int = 2,
+    steps: Iterable[float] = (1.0, 2.5, 5.0),
+) -> list[float]:
+    """Bucket upper bounds: ``step × 10^e`` for each decade — log-linear."""
+    return [m * (10.0 ** e) for e in range(lo_exp, hi_exp + 1) for m in steps]
+
+
+#: default bounds for seconds-valued histograms (1µs … 500s, 27 buckets)
+DEFAULT_SECONDS_BOUNDS = log_linear_bounds()
+
+
+class Histogram:
+    """Fixed-bound histogram with a Prometheus-shaped snapshot."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Iterable[float] | None = None) -> None:
+        self.bounds = sorted(bounds) if bounds else list(DEFAULT_SECONDS_BOUNDS)
+        self.counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        # le is an *inclusive* upper bound (Prometheus semantics):
+        # bisect_left sends v == bound into that bound's bucket
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def snapshot(self) -> dict:
+        """``{"buckets": [(le, cumulative_count), ...], "sum", "count"}``
+        with cumulative counts and a trailing ``+Inf`` bucket — exactly
+        what ``Exposition.histogram`` renders."""
+        buckets = []
+        running = 0
+        for le, c in zip(self.bounds, self.counts):
+            running += c
+            buckets.append((le, running))
+        buckets.append((float("inf"), running + self.counts[-1]))
+        return {"buckets": buckets, "sum": self.sum, "count": self.count}
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+#: HELP text per metric family — registered at first use, read by the
+#: exporter so /metrics carries real descriptions, not just names
+_FAMILY_HELP: dict[str, str] = {
+    "events_total": "structured telemetry events recorded, by event name",
+    "http_requests_total": "HTTP requests served, by route and status",
+    "http_request_seconds": "HTTP request latency by route",
+    "node_event_seconds": "WS/HTTP event handler latency by event type",
+    "ws_frame_decode_seconds": "wire-v2 binary frame decode time",
+    "wire_bytes_total": "bytes over the websocket wire, by direction/codec",
+    "report_bytes_total": "FL diff upload bytes, by wire codec",
+    "model_download_bytes_total": "FL checkpoint download bytes, by codec",
+    "report_latency_seconds": "worker assign-to-report latency",
+    "cycle_phase_seconds": "FL cycle phase durations, by phase",
+    "cycles_completed_total": "FL cycles closed, by outcome",
+    "heartbeat_rtt_seconds": "network→node heartbeat round trip, by transport",
+    "monitor_polls_total": "monitor sweeps per node, by outcome",
+}
+
+
+def family_help(name: str) -> str:
+    return _FAMILY_HELP.get(name, f"pygrid telemetry metric {name}")
+
+
+class TelemetryBus:
+    def __init__(self, ring_size: int = RING_SIZE) -> None:
+        self._lock = threading.Lock()
+        self._events: deque[dict] = deque(maxlen=ring_size)
+        self._counters: dict[tuple[str, tuple], float] = {}
+        self._histograms: dict[tuple[str, tuple], Histogram] = {}
+
+    # ── producers (the hot-path surface) ────────────────────────────────
+
+    def record(self, event: str, /, **fields: Any) -> None:
+        """Append a structured event to the ring and count its family.
+        ``event`` is positional-only so fields named ``event`` cannot
+        collide; the name key still wins in the stored entry."""
+        entry = {**fields, "event": event, "ts": time.time()}
+        key = ("events_total", (("event", event),))
+        with self._lock:
+            self._events.append(entry)
+            self._counters[key] = self._counters.get(key, 0) + 1
+
+    def incr(self, name: str, value: float = 1, **labels: Any) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        bounds: Iterable[float] | None = None,
+        **labels: Any,
+    ) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            hist = self._histograms.get(key)
+            if hist is None:
+                hist = self._histograms[key] = Histogram(bounds)
+            hist.observe(value)
+
+    # ── consumers (snapshots — never expose live internals) ─────────────
+
+    def events(
+        self, event: str | None = None, limit: int | None = None
+    ) -> list[dict]:
+        with self._lock:
+            out = list(self._events)
+        if event is not None:
+            out = [e for e in out if e.get("event") == event]
+        if limit is not None:
+            out = out[-limit:]
+        return out
+
+    def counters(self) -> dict[tuple[str, tuple], float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def histograms(self) -> dict[tuple[str, tuple], dict]:
+        with self._lock:
+            return {k: h.snapshot() for k, h in self._histograms.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._counters.clear()
+            self._histograms.clear()
+
+
+#: the process-wide bus — module functions below are its bound methods,
+#: so call sites stay one import + one call
+BUS = TelemetryBus()
+
+record = BUS.record
+incr = BUS.incr
+observe = BUS.observe
+events = BUS.events
+counters = BUS.counters
+histograms = BUS.histograms
+reset = BUS.reset

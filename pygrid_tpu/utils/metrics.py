@@ -4,6 +4,8 @@ SURVEY §5.5)."""
 
 from __future__ import annotations
 
+import math
+
 
 def _escape(value) -> str:
     """Prometheus label-value escaping — one bad value must not corrupt
@@ -16,15 +18,40 @@ def _escape(value) -> str:
     )
 
 
+def _fmt(value) -> str:
+    """Sample/``le`` value formatting: ``+Inf`` for infinity, ``%g``
+    otherwise (Prometheus accepts scientific notation)."""
+    v = float(value)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return f"{v:g}"
+
+
 class Exposition:
-    """Collects metric families; one HELP/TYPE per name no matter how many
-    labeled samples (a second HELP line for a name fails the whole
-    Prometheus scrape)."""
+    """Collects metric families and renders them GROUPED: one HELP/TYPE
+    per name, and all of a family's samples contiguous, no matter what
+    order callers mixed them in (interleaved family groups fail a strict
+    Prometheus parse just like a second HELP line does)."""
 
     def __init__(self, prefix: str = "pygrid") -> None:
         self.prefix = prefix
-        self._lines: list[str] = []
-        self._declared: set[str] = set()
+        #: full name -> (help, type, [sample lines]) in declaration order
+        self._families: dict[str, tuple[str, str, list[str]]] = {}
+
+    def _family(self, full: str, help_: str, type_: str) -> list[str]:
+        entry = self._families.get(full)
+        if entry is None:
+            entry = self._families[full] = (help_, type_, [])
+        return entry[2]
+
+    @staticmethod
+    def _labels(labels: dict | None) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(
+            f'{k}="{_escape(v)}"' for k, v in labels.items()
+        )
+        return "{" + inner + "}"
 
     def sample(
         self,
@@ -35,17 +62,8 @@ class Exposition:
         type_: str = "gauge",
     ) -> None:
         full = f"{self.prefix}_{name}"
-        if full not in self._declared:
-            self._lines.append(f"# HELP {full} {help_}")
-            self._lines.append(f"# TYPE {full} {type_}")
-            self._declared.add(full)
-        label_str = ""
-        if labels:
-            inner = ",".join(
-                f'{k}="{_escape(v)}"' for k, v in labels.items()
-            )
-            label_str = "{" + inner + "}"
-        self._lines.append(f"{full}{label_str} {value}")
+        lines = self._family(full, help_, type_)
+        lines.append(f"{full}{self._labels(labels)} {value}")
 
     def counter(self, name: str, value, help_: str, labels: dict | None = None) -> None:
         self.sample(name, value, help_, labels, type_="counter")
@@ -53,5 +71,32 @@ class Exposition:
     def gauge(self, name: str, value, help_: str, labels: dict | None = None) -> None:
         self.sample(name, value, help_, labels, type_="gauge")
 
+    def histogram(
+        self,
+        name: str,
+        snapshot: dict,
+        help_: str,
+        labels: dict | None = None,
+    ) -> None:
+        """One histogram series from a bus snapshot: ``{"buckets":
+        [(le, cumulative_count), ...], "sum": float, "count": int}``
+        (``+Inf`` bucket last) — rendered as the ``_bucket``/``_sum``/
+        ``_count`` member samples of one declared family."""
+        full = f"{self.prefix}_{name}"
+        lines = self._family(full, help_, "histogram")
+        base = dict(labels or {})
+        for le, count in snapshot["buckets"]:
+            lines.append(
+                f"{full}_bucket"
+                f"{self._labels({**base, 'le': _fmt(le)})} {count}"
+            )
+        lines.append(f"{full}_sum{self._labels(base)} {_fmt(snapshot['sum'])}")
+        lines.append(f"{full}_count{self._labels(base)} {snapshot['count']}")
+
     def render(self) -> str:
-        return "\n".join(self._lines) + "\n"
+        out: list[str] = []
+        for full, (help_, type_, lines) in self._families.items():
+            out.append(f"# HELP {full} {help_}")
+            out.append(f"# TYPE {full} {type_}")
+            out.extend(lines)
+        return "\n".join(out) + "\n"

@@ -12,6 +12,8 @@ TINY=True
 [ "$1" = "--full" ] && TINY=False
 JAX_PLATFORMS=cpu python -c "
 import json
-from bench import bench_wire
-print(json.dumps(bench_wire(tiny=$TINY), indent=2))
+from bench import bench_telemetry_overhead, bench_wire
+out = bench_wire(tiny=$TINY)
+out.update(bench_telemetry_overhead(tiny=$TINY))
+print(json.dumps(out, indent=2))
 "

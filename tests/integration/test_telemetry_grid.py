@@ -1,0 +1,277 @@
+"""Telemetry over a real grid: one FL cycle produces a single stitched
+trace retrievable from ``GET /telemetry/cycles/<id>``, both ``/metrics``
+endpoints pass a strict Prometheus text parse with the new families, and
+a legacy JSON client without trace headers still completes a cycle under
+a server-synthesized trace."""
+
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from pygrid_tpu import telemetry
+from pygrid_tpu.client import FLClient, ModelCentricFLClient
+from pygrid_tpu.federated.auth import jwt_encode
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.telemetry import promtext
+
+SECRET = "telemetry-secret"
+NAME, VERSION = "telemetry-mnist", "1.0"
+D, H, C, B = 16, 8, 4, 4
+
+
+def _host(grid, node: str, name: str):
+    params = mlp.init(jax.random.PRNGKey(3), (D, H, C))
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *[np.asarray(p) for p in params],
+    )
+    client = ModelCentricFLClient(grid.node_url(node))
+    response = client.host_federated_training(
+        model=[np.asarray(p) for p in params],
+        client_plans={"training_plan": plan},
+        client_config={"name": name, "version": VERSION, "batch_size": B},
+        server_config={
+            "min_workers": 1,
+            "max_workers": 4,
+            "pool_selection": "random",
+            "do_not_reuse_workers_until_cycle": 0,
+            "num_cycles": 3,
+            "max_diffs": 1,
+            "min_diffs": 1,
+            "authentication": {"secret": SECRET},
+        },
+    )
+    assert response.get("status") == "success", response
+    client.close()
+    return [np.asarray(p) for p in params]
+
+
+def _run_cycle(client: FLClient, name: str):
+    """One full accepted round: start (download) → report; returns the
+    job so the test can inspect its trace root."""
+    job = client.new_job(name, VERSION)
+    state = {}
+    job.add_listener(job.EVENT_ACCEPTED, lambda j: state.update(ok=True))
+    job.add_listener(
+        job.EVENT_ERROR, lambda j, e: state.update(err=e)
+    )
+    job.add_listener(
+        job.EVENT_REJECTED, lambda j, t: state.update(rejected=True)
+    )
+    job.start()
+    assert state.get("ok"), state
+    diff = [0.01 * np.asarray(p) for p in job.model_params]
+    out = job.report(diff)
+    assert out.get("status") == "success", out
+    return job
+
+
+def test_full_cycle_single_stitched_trace(grid):
+    _host(grid, "bob", NAME)
+    client = FLClient(
+        grid.node_url("bob"),
+        auth_token=jwt_encode({"sub": "w"}, secret=SECRET),
+        wire="auto",
+    )
+    try:
+        job = _run_cycle(client, NAME)
+    finally:
+        client.close()
+    tid = job.trace_ctx.trace_id
+
+    # the cycle's timeline names the client's trace id — client and node
+    # spans stitched by one trace_id
+    listing = requests.get(
+        grid.node_url("bob") + "/telemetry/cycles", timeout=10
+    ).json()["cycles"]
+    cycle_id = next(
+        c["cycle_id"] for c in listing if tid_in_cycle(grid, c, tid)
+    )
+    detail = requests.get(
+        grid.node_url("bob") + f"/telemetry/cycles/{cycle_id}", timeout=10
+    ).json()
+    assert tid in detail["traces"]
+    assert detail["completed"] is True
+    assert detail["reported"] >= 1 and detail["stragglers"] == 0
+    assert detail["phases"].get("aggregate", 0) > 0
+    # per-worker report record carries latency + bytes + the trace id
+    (worker_rec,) = [
+        w for w in detail["workers"].values() if w.get("trace_id") == tid
+    ]
+    assert worker_rec["report_bytes"] > 0
+    assert worker_rec["report_latency_s"] >= 0
+    # download + upload bytes attributed per codec
+    assert any(k.startswith("upload/") for k in detail["bytes"])
+    assert any(k.startswith("download/") for k in detail["bytes"])
+
+    # both ends recorded spans under the SAME trace id (grid runs
+    # in-process, so the bus holds both sides)
+    node_spans = [
+        e for e in telemetry.events(event="node.event")
+        if e.get("trace_id") == tid
+    ]
+    client_spans = [
+        e for e in telemetry.events(event="span")
+        if e.get("trace_id") == tid
+    ]
+    assert node_spans and client_spans
+    node_names = {e["name"] for e in node_spans}
+    assert "model-centric/report" in node_names
+
+
+def tid_in_cycle(grid, summary: dict, tid: str) -> bool:
+    detail = requests.get(
+        grid.node_url("bob") + f"/telemetry/cycles/{summary['cycle_id']}",
+        timeout=10,
+    ).json()
+    return tid in detail.get("traces", [])
+
+
+def test_legacy_json_client_without_trace_gets_synthesized_trace(grid):
+    """A reference-era client (plain HTTP, no trace headers anywhere)
+    completes a cycle, and the node still records a server-synthesized
+    trace for its report."""
+    name = "telemetry-legacy"
+    _host(grid, "charlie", name)
+    base = grid.node_url("charlie")
+    auth = requests.post(
+        base + "/model-centric/authenticate",
+        json={
+            "auth_token": jwt_encode({"sub": "w"}, secret=SECRET),
+            "model_name": name,
+            "model_version": VERSION,
+        },
+        timeout=10,
+    ).json()
+    assert auth.get("status") == "success", auth
+    cyc = requests.post(
+        base + "/model-centric/cycle-request",
+        json={
+            "worker_id": auth["worker_id"], "model": name,
+            "version": VERSION, "ping": 1.0, "download": 1000.0,
+            "upload": 1000.0,
+        },
+        timeout=10,
+    ).json()
+    assert cyc["status"] == "accepted", cyc
+    blob = requests.get(
+        base + "/model-centric/get-model",
+        params={
+            "worker_id": auth["worker_id"],
+            "request_key": cyc["request_key"],
+            "model_id": str(cyc["model_id"]),
+        },
+        timeout=10,
+    )
+    assert blob.status_code == 200
+    from pygrid_tpu.plans.state import (
+        serialize_model_params,
+        unserialize_model_params,
+    )
+
+    params = unserialize_model_params(blob.content)
+    diff = serialize_model_params([0.01 * np.asarray(p) for p in params])
+    import base64 as b64
+
+    report = requests.post(
+        base + "/model-centric/report",
+        json={
+            "worker_id": auth["worker_id"],
+            "request_key": cyc["request_key"],
+            "diff": b64.b64encode(diff).decode(),
+        },
+        timeout=10,
+    ).json()
+    assert report.get("status") == "success", report
+
+    # the node synthesized a root trace: the cycle's worker record has a
+    # trace id the client never sent
+    listing = requests.get(base + "/telemetry/cycles", timeout=10).json()
+    completed = [
+        c for c in listing["cycles"] if c["outcome"] == "aggregated"
+    ]
+    assert completed
+    detail = requests.get(
+        base + f"/telemetry/cycles/{completed[0]['cycle_id']}", timeout=10
+    ).json()
+    assert detail["traces"], detail
+    recs = [
+        w for w in detail["workers"].values() if w.get("trace_id")
+    ]
+    assert recs and all(len(w["trace_id"]) == 32 for w in recs)
+
+
+def test_metrics_scrape_strictly_valid_with_new_families(grid):
+    """Both apps' /metrics parse under the strict checker and expose the
+    new histogram/counter families (≥6 beyond the pre-existing gauges)."""
+    name = "telemetry-scrape"
+    _host(grid, "alice", name)
+    client = FLClient(
+        grid.node_url("alice"),
+        auth_token=jwt_encode({"sub": "w"}, secret=SECRET),
+        wire="auto",
+    )
+    try:
+        _run_cycle(client, name)
+    finally:
+        client.close()
+    # let the network's monitor sweep at least once (0.3 s interval)
+    time.sleep(1.0)
+
+    node_families = promtext.parse(
+        requests.get(grid.node_url("alice") + "/metrics", timeout=10).text
+    )
+    expected_node = {
+        "pygrid_http_requests_total",
+        "pygrid_http_request_seconds",
+        "pygrid_events_total",
+        "pygrid_node_event_seconds",
+        "pygrid_wire_bytes_total",
+        "pygrid_report_latency_seconds",
+        "pygrid_report_bytes_total",
+        "pygrid_model_download_bytes_total",
+        "pygrid_cycle_phase_seconds",
+        "pygrid_cycles_completed_total",
+        "pygrid_serde_tensor_copies_total",
+    }
+    missing = expected_node - set(node_families)
+    assert not missing, f"node /metrics missing {missing}"
+    assert node_families["pygrid_node_event_seconds"].type == "histogram"
+    assert node_families["pygrid_report_latency_seconds"].type == "histogram"
+
+    network_families = promtext.parse(
+        requests.get(grid.network_url + "/metrics", timeout=10).text
+    )
+    expected_network = {
+        "pygrid_grid_nodes_total",
+        "pygrid_grid_nodes",
+        "pygrid_http_requests_total",
+        "pygrid_http_request_seconds",
+        "pygrid_heartbeat_rtt_seconds",
+        "pygrid_monitor_polls_total",
+        "pygrid_serde_tensor_copies_total",
+    }
+    missing = expected_network - set(network_families)
+    assert not missing, f"network /metrics missing {missing}"
+    assert (
+        network_families["pygrid_heartbeat_rtt_seconds"].type == "histogram"
+    )
+
+
+def test_telemetry_events_route_filters(grid):
+    base = grid.node_url("alice")
+    out = requests.get(
+        base + "/telemetry/events", params={"event": "node.event"},
+        timeout=10,
+    ).json()
+    assert all(e["event"] == "node.event" for e in out["events"])
+    missing = requests.get(base + "/telemetry/cycles/999999", timeout=10)
+    assert missing.status_code == 404

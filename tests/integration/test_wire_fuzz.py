@@ -422,7 +422,15 @@ def test_hostile_generation_payloads_bounce_typed(ctx):
         dict(data=good_prompt, n_new=2, temperature="hot"),
         dict(data=good_prompt, n_new=2, temperature=-1.0),
         dict(data=good_prompt, n_new=2, temperature=float("nan")),
+        # Infinity passes a bare >= 0 check but collapses logits/inf to
+        # all-zero — uniform-random tokens silently served (ADVICE #2)
+        dict(data=good_prompt, n_new=2, temperature=float("inf")),
         dict(data=good_prompt, n_new=2, temperature=0.5, seed="x"),
+        # seeds past int64 overflow PRNGKey with an uncaught
+        # OverflowError without the range gate (ADVICE #1)
+        dict(data=good_prompt, n_new=2, temperature=0.5, seed=2**63),
+        dict(data=good_prompt, n_new=2, temperature=0.5, seed=10**30),
+        dict(data=good_prompt, n_new=2, temperature=0.5, seed=-(2**64)),
         dict(data=base64.b64encode(serialize(
             np.array([[1.5, 2.5]], np.float32)
         )).decode(), n_new=2),                         # float prompt
@@ -471,3 +479,7 @@ def test_hostile_generation_payloads_bounce_typed(ctx):
         dec.generate(params, np.array([[1, 2]], np.int32), 3, cfg)
     )
     np.testing.assert_array_equal(np.asarray(payload["tokens"]), local)
+    # a legitimate large-but-in-range seed still serves
+    out = gen(data=good_prompt, n_new=2, temperature=0.5, seed=2**62)
+    payload = out.get("data", out)
+    assert payload.get("success"), out

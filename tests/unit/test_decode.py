@@ -95,3 +95,96 @@ def test_bf16_decode_close_to_f32(setup):
     )
     scale = float(jnp.max(jnp.abs(lf))) + 1e-9
     assert float(jnp.max(jnp.abs(lf - lb))) / scale < 0.05
+
+
+def test_traced_temperature_zero_falls_back_to_greedy(setup):
+    """A traced temperature that is 0 at runtime must serve the greedy
+    tokens — not NaN logits through jax.random.categorical (ADVICE #4).
+    One compiled program serves every temperature INCLUDING zero."""
+    params, prompt = setup
+    key = jax.random.PRNGKey(11)
+    fn = jax.jit(
+        lambda p, x, k, t: decode.generate(
+            p, x, 5, CFG, temperature=t, key=k
+        )
+    )
+    zero_t = fn(params, prompt, key, jnp.float32(0.0))
+    greedy = decode.generate(params, prompt, 5, CFG)
+    np.testing.assert_array_equal(np.asarray(zero_t), np.asarray(greedy))
+    # and the same program still samples at a positive temperature
+    hot = fn(params, prompt, key, jnp.float32(0.8))
+    eager = decode.generate(params, prompt, 5, CFG, temperature=0.8, key=key)
+    np.testing.assert_array_equal(np.asarray(hot), np.asarray(eager))
+
+
+def test_generation_jit_cache_evicts_lru_not_everything():
+    """Cache pressure (a client cycling trace-relevant keys) pops only
+    the least-recently-used compiled program; a hot entry that keeps
+    being touched survives (ADVICE #3 — .clear() let one client flush
+    every model's hot programs at once)."""
+    from pygrid_tpu.node.events import _GENERATION_JIT, _generation_fn
+
+    _GENERATION_JIT.clear()
+    try:
+        cfg_hot = (19, 8, 1, 1, 16, 8)
+        hot = _generation_fn(cfg_hot, 1, False)
+        for d_ff in range(100, 180):  # well past the 64-entry cap
+            _generation_fn((19, 8, 1, 1, d_ff, 8), 1, False)
+            # the hot program is touched between insertions, so LRU
+            # keeps it while cold entries rotate out
+            assert _generation_fn(cfg_hot, 1, False) is hot
+        assert len(_GENERATION_JIT) <= 64
+        assert (cfg_hot, 1, False) in _GENERATION_JIT
+    finally:
+        _GENERATION_JIT.clear()
+
+
+def test_run_generation_validates_seed_and_temperature(setup):
+    """The serving endpoint bounces hostile seed/temperature values as
+    typed {success: False} frames: seeds past int64 (ADVICE #1, formerly
+    an uncaught OverflowError) and non-finite temperatures (ADVICE #2,
+    formerly silently-uniform tokens)."""
+    import base64
+    from types import SimpleNamespace
+
+    from pygrid_tpu.node import NodeContext
+    from pygrid_tpu.node.events import Connection, run_generation
+    from pygrid_tpu.serde import serialize
+
+    params, _ = setup
+    ctx = NodeContext("decode-validation")
+    conn = Connection(ctx, socket=object())
+    conn.session = SimpleNamespace(worker=None)
+    hosted = ctx.models.save(
+        ctx.local_worker.id,
+        serialize(decode.bundle(CFG, params)),
+        "gen-val",
+        allow_download=False,
+        allow_remote_inference=True,
+        mpc=False,
+    )
+    assert hosted.get("success"), hosted
+    prompt = base64.b64encode(
+        serialize(np.array([[1, 2]], np.int32))
+    ).decode()
+
+    def gen(**fields):
+        return run_generation(
+            ctx,
+            {"model_id": "gen-val", "data": prompt, "n_new": 2, **fields},
+            conn,
+        )
+
+    for bad in (
+        dict(temperature=float("inf")),
+        dict(temperature=float("-inf")),
+        dict(temperature=0.5, seed=2**63),
+        dict(temperature=0.5, seed=10**30),
+        dict(temperature=0.5, seed=-(2**64)),
+        dict(temperature=0.5, seed=-1),
+    ):
+        out = gen(**bad)
+        assert out.get("success") is False and "error" in out, (bad, out)
+    # in-range values still serve
+    ok = gen(temperature=0.5, seed=2**62)
+    assert ok.get("success") is True, ok

@@ -1,0 +1,299 @@
+"""Telemetry subsystem: event bus, trace context + wire encodings,
+histogram exposition, the strict Prometheus text parser, and the
+overhead budget (CI twin of ``bench_telemetry_overhead``)."""
+
+from __future__ import annotations
+
+import math
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from pygrid_tpu import telemetry
+from pygrid_tpu.serde import (
+    TRACE_HEADER_BYTES,
+    decode_frame,
+    decode_frame_traced,
+    encode_frame,
+)
+from pygrid_tpu.telemetry import promtext, timeline, trace
+from pygrid_tpu.telemetry.bus import Histogram, TelemetryBus
+from pygrid_tpu.utils.metrics import Exposition
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    telemetry.reset()
+    timeline.reset()
+    yield
+    telemetry.reset()
+    timeline.reset()
+
+
+# ── bus ─────────────────────────────────────────────────────────────────
+
+
+def test_record_rings_and_counts():
+    bus = TelemetryBus(ring_size=3)
+    for i in range(5):
+        bus.record("tick", i=i)
+    events = bus.events()
+    assert [e["i"] for e in events] == [2, 3, 4]  # ring evicted 0, 1
+    assert bus.counters()[("events_total", (("event", "tick"),))] == 5
+
+
+def test_record_event_key_cannot_be_shadowed():
+    bus = TelemetryBus()
+    bus.record("span", event="model-centric/report")
+    (entry,) = bus.events()
+    assert entry["event"] == "span"  # the name wins over a field
+
+
+def test_counters_labeled_independently():
+    bus = TelemetryBus()
+    bus.incr("wire_bytes_total", 10, direction="in")
+    bus.incr("wire_bytes_total", 5, direction="out")
+    bus.incr("wire_bytes_total", 1, direction="in")
+    got = bus.counters()
+    assert got[("wire_bytes_total", (("direction", "in"),))] == 11
+    assert got[("wire_bytes_total", (("direction", "out"),))] == 5
+
+
+def test_histogram_log_linear_buckets_cumulative():
+    h = Histogram(bounds=[0.001, 0.01, 0.1])
+    for v in (0.0005, 0.001, 0.05, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    # le is inclusive: 0.001 lands in the 0.001 bucket
+    assert snap["buckets"] == [
+        (0.001, 2), (0.01, 2), (0.1, 3), (math.inf, 4),
+    ]
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(5.0515)
+
+
+def test_bus_threadsafe_under_contention():
+    bus = TelemetryBus()
+
+    def worker():
+        for _ in range(500):
+            bus.incr("n")
+            bus.observe("lat", 0.01)
+            bus.record("e")
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert bus.counters()[("n", ())] == 4000
+    assert bus.histograms()[("lat", ())]["count"] == 4000
+
+
+# ── trace context ───────────────────────────────────────────────────────
+
+
+def test_trace_header_text_roundtrip():
+    ctx = trace.TraceContext(trace.new_trace_id(), trace.new_span_id())
+    assert trace.parse_header(trace.header(ctx)) == ctx
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [None, 42, "", "zz", "deadbeef", "x" * 49, "G" * 32 + "-" + "0" * 16],
+)
+def test_trace_header_rejects_malformed(bad):
+    assert trace.parse_header(bad) is None
+
+
+def test_trace_bytes_roundtrip():
+    ctx = trace.TraceContext(trace.new_trace_id(), trace.new_span_id())
+    raw = trace.to_bytes(ctx)
+    assert len(raw) == TRACE_HEADER_BYTES
+    assert trace.from_bytes(raw) == ctx
+    assert trace.from_bytes(b"short") is None
+    assert trace.from_bytes(None) is None
+
+
+def test_span_nesting_shares_trace_and_links_parents():
+    with trace.span("outer") as outer:
+        with trace.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.span_id != outer.span_id
+    spans = telemetry.events(event="span")
+    by_name = {e["name"]: e for e in spans}
+    assert by_name["inner"]["parent_id"] == outer.span_id
+    assert by_name["outer"]["parent_id"] is None
+    assert by_name["outer"]["duration_s"] >= 0
+    assert trace.current() is None  # context restored
+
+
+def test_serve_adopts_incoming_and_synthesizes_root():
+    incoming = trace.TraceContext(trace.new_trace_id(), trace.new_span_id())
+    with trace.serve(incoming) as served:
+        assert served.trace_id == incoming.trace_id
+        assert served.span_id != incoming.span_id
+    with trace.serve(None) as synthesized:  # legacy client
+        assert len(synthesized.trace_id) == 32
+
+
+# ── wire-v2 frame trace header ──────────────────────────────────────────
+
+
+def test_frame_trace_header_roundtrip_all_codecs():
+    ctx = trace.TraceContext(trace.new_trace_id(), trace.new_span_id())
+    tb = trace.to_bytes(ctx)
+    compressible = b"abc" * 4096
+    for codec in (None, "zlib"):
+        frame = encode_frame(compressible, codec, trace=tb)
+        assert frame[0] & 0x80  # the trace flag
+        payload, got = decode_frame_traced(frame)
+        assert bytes(payload) == compressible
+        assert got == tb
+        # decode_frame (the untraced door) skips the header transparently
+        assert bytes(decode_frame(frame)) == compressible
+
+
+def test_untraced_frames_are_byte_identical_to_v1():
+    assert encode_frame(b"payload") == b"\x00payload"
+    payload, tb = decode_frame_traced(b"\x00payload")
+    assert bytes(payload) == b"payload" and tb is None
+
+
+def test_frame_truncated_trace_header_is_typed_error():
+    with pytest.raises(ValueError, match="trace header"):
+        decode_frame(b"\x80short")
+    with pytest.raises(ValueError, match="24 bytes"):
+        encode_frame(b"x", trace=b"short")
+
+
+# ── timeline ────────────────────────────────────────────────────────────
+
+
+def test_timeline_records_one_cycle_end_to_end():
+    timeline.cycle_started(7, fl_process_id=1, sequence=3)
+    timeline.worker_assigned(7, "w1", trace_id="t" * 32)
+    timeline.worker_report(
+        7, "w1", latency_s=0.5, n_bytes=1000, codec="zlib",
+        trace_id="t" * 32,
+    )
+    timeline.add_bytes(7, "download", "zlib", 2000)
+    timeline.phase(7, "aggregate", 0.25)
+    timeline.cycle_closed(7, assigned=2, reported=1)
+    snap = timeline.snapshot(7)
+    assert snap["sequence"] == 3
+    assert snap["stragglers"] == 1
+    assert snap["phases"]["aggregate"] == pytest.approx(0.25)
+    assert snap["workers"]["w1"]["report_bytes"] == 1000
+    assert snap["bytes"] == {"upload/zlib": 1000, "download/zlib": 2000}
+    assert snap["traces"] == ["t" * 32]
+    assert timeline.recent(5)[0]["cycle_id"] == 7
+
+
+def test_timeline_bounded_eviction():
+    for cid in range(timeline.MAX_CYCLES + 10):
+        timeline.cycle_started(cid)
+    assert timeline.snapshot(0) is None   # evicted
+    assert timeline.snapshot(timeline.MAX_CYCLES + 9) is not None
+
+
+# ── exposition + strict parser ──────────────────────────────────────────
+
+
+def test_exposition_histogram_renders_and_parses():
+    telemetry.observe("http_request_seconds", 0.02, route="/metrics")
+    telemetry.observe("http_request_seconds", 1.5, route="/metrics")
+    telemetry.incr("http_requests_total", 2, route="/metrics", code="200")
+    exp = Exposition()
+    telemetry.export(exp)
+    families = promtext.parse(exp.render())
+    hist = families["pygrid_http_request_seconds"]
+    assert hist.type == "histogram"
+    buckets = [s for s in hist.samples if s[0].endswith("_bucket")]
+    assert any(math.isinf(float(s[1]["le"])) for s in buckets)
+    count = [s for s in hist.samples if s[0].endswith("_count")][0]
+    assert count[2] == 2
+    assert families["pygrid_http_requests_total"].type == "counter"
+
+
+def test_exposition_groups_interleaved_families():
+    exp = Exposition()
+    # callers interleave two families; render must group them
+    exp.counter("a_total", 1, "a", {"k": "1"})
+    exp.counter("b_total", 1, "b", {"k": "1"})
+    exp.counter("a_total", 2, "a", {"k": "2"})
+    families = promtext.parse(exp.render())  # strict: raises if interleaved
+    assert len(families["pygrid_a_total"].samples) == 2
+
+
+def test_exposition_escapes_hostile_label_values():
+    exp = Exposition()
+    exp.gauge("g", 1, "h", {"name": 'evil"\\\n'})
+    families = promtext.parse(exp.render())
+    assert families["pygrid_g"].samples[0][1]["name"] == 'evil"\\\n'
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "no_trailing_newline",
+        "# HELP a h\n# HELP a again\n# TYPE a counter\na 1\n",
+        "# TYPE a counter\n# TYPE a counter\na 1\n",
+        "# TYPE a counter\na{l=unquoted} 1\n",
+        "# TYPE a counter\na 1\na 1\n",                     # duplicate series
+        "a_undeclared 1\n",
+        "# TYPE a counter\n# TYPE b counter\na 1\nb 1\na{x=\"2\"} 2\n",
+        (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'      # not cumulative
+            'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n'
+        ),
+        (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\nh_sum 1\nh_count 5\n'      # no +Inf
+        ),
+    ],
+)
+def test_promtext_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        promtext.parse(bad)
+
+
+def test_promtext_accepts_current_node_exposition_shape():
+    text = (
+        "# HELP pygrid_workers_total FL workers ever registered\n"
+        "# TYPE pygrid_workers_total counter\n"
+        "pygrid_workers_total 4\n"
+        "# HELP pygrid_grid_nodes nodes by monitor status\n"
+        "# TYPE pygrid_grid_nodes gauge\n"
+        'pygrid_grid_nodes{status="online"} 3\n'
+        'pygrid_grid_nodes{status="offline"} 1\n'
+    )
+    families = promtext.parse(text)
+    assert families["pygrid_workers_total"].samples[0][2] == 4
+
+
+# ── the overhead budget (CI twin of the capture bench) ──────────────────
+
+
+def test_telemetry_overhead_within_budget():
+    from bench import bench_telemetry_overhead
+
+    out = bench_telemetry_overhead(tiny=True)
+    # the trace header is 25 bytes against kilobytes of payload — far
+    # under the 2% byte budget even on the tiny shapes
+    assert out["telemetry_byte_overhead_pct"] < 2.0
+    # on the ~1000×-smaller CI shapes a percentage bound is meaningless
+    # (the round itself is ~40µs), so CI bounds the ABSOLUTE per-round
+    # cost instead: ≤ 0.5 ms fixed overhead is what keeps the full-scale
+    # round (≥ 40 ms, where the ≤2% acceptance criterion is measured —
+    # full bench: -0.07% latency, +0.0001% bytes) inside its budget
+    overhead_ms = (
+        out["telemetry_roundtrip_ms_traced"]
+        - out["telemetry_roundtrip_ms_plain"]
+    )
+    assert overhead_ms < 0.5, out

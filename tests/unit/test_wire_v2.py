@@ -202,22 +202,39 @@ def test_wire_version_bumped():
 
 
 def test_offer_and_select_matrix():
-    # plain v2 is always the last offer (codec-less servers still match)
+    from pygrid_tpu.serde import WS_SUBPROTOCOL_V2_TRACE, subprotocol_traced
+
+    # plain v2 is always the last offer (codec-less servers still match);
+    # trace-capable variants lead so a trace-aware server prefers them
     offers = offered_subprotocols("auto")
     assert offers[-1] == WS_SUBPROTOCOL_V2
+    assert offers[0].startswith(WS_SUBPROTOCOL_V2_TRACE)
     assert all(o.startswith(WS_SUBPROTOCOL_V2) for o in offers)
-    assert offered_subprotocols(None) == [WS_SUBPROTOCOL_V2]
+    assert offered_subprotocols(None) == [
+        WS_SUBPROTOCOL_V2_TRACE, WS_SUBPROTOCOL_V2,
+    ]
     with pytest.raises(ValueError):
         offered_subprotocols("nope")
-    # selection → (v2, codec)
+    # selection → (v2, codec); trace variants negotiate the same codec
     assert subprotocol_codec(WS_SUBPROTOCOL_V2) == (True, None)
+    assert subprotocol_codec(WS_SUBPROTOCOL_V2_TRACE) == (True, None)
     for c in available_codecs():
         assert subprotocol_codec(f"{WS_SUBPROTOCOL_V2}+{c}") == (True, c)
+        assert subprotocol_codec(f"{WS_SUBPROTOCOL_V2_TRACE}+{c}") == (True, c)
+    # the 0x80 tag bit is only licensed by the .trace variant
+    assert subprotocol_traced(WS_SUBPROTOCOL_V2_TRACE) is True
+    assert subprotocol_traced(f"{WS_SUBPROTOCOL_V2_TRACE}+zlib") is True
+    assert subprotocol_traced(WS_SUBPROTOCOL_V2) is False
+    assert subprotocol_traced(f"{WS_SUBPROTOCOL_V2}+zlib") is False
+    assert subprotocol_traced(f"{WS_SUBPROTOCOL_V2_TRACE}+brotli") is False
     # no selection / foreign selection → legacy framing
     assert subprotocol_codec(None) == (False, None)
     assert subprotocol_codec("graphql-ws") == (False, None)
     # a codec this build can't run degrades to legacy, never an error
     assert subprotocol_codec(f"{WS_SUBPROTOCOL_V2}+brotli") == (False, None)
+    assert subprotocol_codec(f"{WS_SUBPROTOCOL_V2_TRACE}+brotli") == (
+        False, None,
+    )
 
 
 # ── model-blob cache: publish invalidation (satellite) ───────────────────────
