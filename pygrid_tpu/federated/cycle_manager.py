@@ -99,6 +99,14 @@ class _DiffAccumulator:
         self.weight_sum += weight
 
     def mean(self) -> list[np.ndarray]:
+        if self.sums is None or self.weight_sum <= 0.0:
+            # a cycle can flush with zero accepted reports (deadline
+            # fires, every diff bounced validation); iterating
+            # sums=None raised a raw TypeError / ZeroDivisionError —
+            # surface the real condition typed instead
+            raise E.PyGridError(
+                "cannot average a cycle with zero accepted reports"
+            )
         return [
             (s / self.weight_sum).astype(np.float32) for s in self.sums
         ]

@@ -2,10 +2,14 @@
 
 The training side of the flagship model lives in
 :mod:`pygrid_tpu.models.transformer`; this module is its inference twin:
-a static-shape KV cache plus a ``lax.scan``-driven ``generate`` so the
-whole decode loop is ONE compiled XLA program (no per-token Python
-dispatch, no dynamic shapes — the cache is allocated at ``max_len`` and
-masked by position, the idiom XLA/TPU wants).
+a static-shape KV cache, a dense single-pass ``prefill``, and a
+``lax.scan``-driven decode loop so a whole ``generate`` call is ONE
+compiled XLA program (no per-token Python dispatch, no dynamic shapes —
+the cache is allocated at ``max_len`` and masked by position, the idiom
+XLA/TPU wants). The ``SlotKVCache`` family below is the continuous-
+batching variant the serving engine (:mod:`pygrid_tpu.serving`) drives:
+one shared cache of request slots, per-slot positions, per-slot masked
+attention.
 
 No reference analog: the reference's inference surface is data-centric
 ``run_inference`` over MLP/CNN plans (SURVEY §2.1); autoregressive
@@ -92,6 +96,24 @@ def init_cache(
     )
 
 
+def _block(h, layer_params, c, attn):
+    """One transformer block with an injected attention stage — the ONE
+    copy of the per-layer numerics every decode variant shares (the
+    bit-identical-greedy contract between ``generate()`` and the slot
+    engine rides on these staying in lockstep). ``attn(x, wq, wk, wv)``
+    receives the ln1 output and the cast projection weights and returns
+    the attention result [..., d_model], handling the q/k/v layout,
+    cache writes, and masking for its variant."""
+    (ln1_s, ln1_b, wq, wk, wv, wo, ln2_s, ln2_b, w1, b1, w2, b2) = (
+        layer_params
+    )
+    x = c(_ln(h, ln1_s, ln1_b))
+    a = attn(x, c(wq), c(wk), c(wv))
+    h = h + c(a) @ c(wo)
+    x = c(_ln(h, ln2_s, ln2_b))
+    return h + c(jax.nn.gelu(x @ c(w1) + c(b1))) @ c(w2) + c(b2)
+
+
 def _decode_attention(q, k_cache, v_cache, n_valid):
     """Masked dense attention of ONE query position against the cache.
 
@@ -135,21 +157,19 @@ def decode_step(
     new_k, new_v = cache.k, cache.v
     idx = 2
     for layer in range(cfg.n_layers):
-        (ln1_s, ln1_b, wq, wk, wv, wo, ln2_s, ln2_b, w1, b1, w2, b2) = (
-            params[idx : idx + PARAMS_PER_LAYER]
-        )
-        x = c(_ln(h, ln1_s, ln1_b))
-        q = (x @ c(wq)).reshape(B, cfg.n_heads, dh)
-        k = (x @ c(wk)).reshape(B, cfg.n_heads, dh)
-        v = (x @ c(wv)).reshape(B, cfg.n_heads, dh)
-        new_k = new_k.at[layer, :, t].set(k.astype(new_k.dtype))
-        new_v = new_v.at[layer, :, t].set(v.astype(new_v.dtype))
-        a = _decode_attention(
-            q, new_k[layer], new_v[layer], t + 1
-        ).reshape(B, cfg.d_model)
-        h = h + c(a) @ c(wo)
-        x = c(_ln(h, ln2_s, ln2_b))
-        h = h + c(jax.nn.gelu(x @ c(w1) + c(b1))) @ c(w2) + c(b2)
+
+        def attn(x, wq, wk, wv, layer=layer):
+            nonlocal new_k, new_v
+            q = (x @ wq).reshape(B, cfg.n_heads, dh)
+            k = (x @ wk).reshape(B, cfg.n_heads, dh)
+            v = (x @ wv).reshape(B, cfg.n_heads, dh)
+            new_k = new_k.at[layer, :, t].set(k.astype(new_k.dtype))
+            new_v = new_v.at[layer, :, t].set(v.astype(new_v.dtype))
+            return _decode_attention(
+                q, new_k[layer], new_v[layer], t + 1
+            ).reshape(B, cfg.d_model)
+
+        h = _block(h, params[idx : idx + PARAMS_PER_LAYER], c, attn)
         idx += PARAMS_PER_LAYER
     h = _ln(h, params[idx], params[idx + 1])
     logits = jnp.dot(
@@ -165,24 +185,252 @@ def prefill(
     cfg: TransformerConfig = TransformerConfig(),
     compute_dtype: Any | None = None,
 ) -> tuple[jax.Array, KVCache]:
-    """Feed a [B, P] prompt token-by-token via ``lax.scan``; returns the
-    last position's logits and the filled cache. O(P·max_len) attention
-    work — fine at serving prompt sizes; the training path (flash) is
-    the tool for long-context ingestion at scale."""
+    """Ingest a [B, P] prompt in ONE dense causal pass; returns the last
+    position's logits and the filled cache.
 
-    def step(carry, tok_t):
-        cache, _ = carry
-        logits, cache = decode_step(
-            params, cache, tok_t, cfg, compute_dtype
-        )
-        return (cache, logits), None
+    All P positions flow through each layer together (causal-masked
+    attention over the whole prompt, k/v written to the cache in bulk via
+    ``dynamic_update_slice``) — the sequential ``lax.scan`` this replaces
+    dispatched P dependent single-token steps, serializing what is a
+    parallel matmul workload. Same numerics contract as the full forward
+    (``tests/unit/test_decode.py`` asserts the last-position logits
+    against ``transformer.apply``)."""
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
-    B = prompt.shape[0]
-    init_logits = jnp.zeros((B, cfg.vocab), jnp.float32)
-    (cache, logits), _ = lax.scan(
-        step, (cache, init_logits), prompt.T
+    def c(x):
+        return _cast(x, cd)
+
+    embed, pos_emb = params[0], params[1]
+    B, P = prompt.shape
+    dh = cfg.d_model // cfg.n_heads
+    t0 = cache.pos
+    positions = t0 + jnp.arange(P)  # global positions of the prompt
+    h = c(embed[prompt] + pos_emb[positions])  # [B, P, d]
+    scale = dh**-0.5
+    #: rows of the cache a query at global position p may read: everything
+    #: written before this prefill plus the causal prefix of the prompt
+    mask = (
+        jnp.arange(cfg.max_len)[None, :] <= positions[:, None]
+    )  # [P, max_len]
+
+    new_k, new_v = cache.k, cache.v
+    idx = 2
+    for layer in range(cfg.n_layers):
+
+        def attn(x, wq, wk, wv, layer=layer):
+            nonlocal new_k, new_v
+            q = (x @ wq).reshape(B, P, cfg.n_heads, dh)
+            k = (x @ wk).reshape(B, P, cfg.n_heads, dh)
+            v = (x @ wv).reshape(B, P, cfg.n_heads, dh)
+            new_k = lax.dynamic_update_slice(
+                new_k, k.astype(new_k.dtype)[None], (layer, 0, t0, 0, 0)
+            )
+            new_v = lax.dynamic_update_slice(
+                new_v, v.astype(new_v.dtype)[None], (layer, 0, t0, 0, 0)
+            )
+            s = jnp.einsum(
+                "bphd,blhd->bhpl", q, new_k[layer],
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(mask[None, None, :, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum(
+                "bhpl,blhd->bphd", p.astype(new_v.dtype), new_v[layer],
+                preferred_element_type=jnp.float32,
+            ).reshape(B, P, cfg.d_model)
+
+        h = _block(h, params[idx : idx + PARAMS_PER_LAYER], c, attn)
+        idx += PARAMS_PER_LAYER
+    h = _ln(h[:, -1], params[idx], params[idx + 1])  # last position only
+    logits = jnp.dot(
+        c(h), c(embed).T, preferred_element_type=jnp.float32
     )
-    return logits, cache
+    return logits, KVCache(k=new_k, v=new_v, pos=t0 + P)
+
+
+# ── slot-structured shared cache (continuous-batching serving) ───────────────
+#
+# The serving engine (pygrid_tpu.serving) keeps ONE persistent cache of S
+# request slots per hosted model and advances every live slot with a single
+# jitted program per step. Requests join a free slot (per-slot prefill),
+# decode together at their own positions, and leave between steps — so the
+# compiled programs are keyed only by (config, slot-width bucket, prompt
+# bucket), never by a request's prompt length or n_new.
+
+
+class SlotKVCache(NamedTuple):
+    """Per-slot key/value cache shared by independent requests.
+
+    ``k``/``v``: [n_layers, S, max_len, n_heads, head_dim]; ``pos``: [S]
+    int32, each slot's count of valid rows. Unlike :class:`KVCache` the
+    "batch" axis carries *unrelated* sequences at *different* positions;
+    every read is masked per slot, so no slot can see another's rows.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+
+
+def init_slot_cache(
+    cfg: TransformerConfig,
+    slots: int,
+    dtype: Any = jnp.float32,
+) -> SlotKVCache:
+    dh = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, slots, cfg.max_len, cfg.n_heads, dh)
+    return SlotKVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        pos=jnp.zeros((slots,), jnp.int32),
+    )
+
+
+def prefill_slot(
+    params: Sequence[jax.Array],
+    cache: SlotKVCache,
+    slot: jax.Array,
+    prompt: jax.Array,
+    length: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    compute_dtype: Any | None = None,
+) -> tuple[jax.Array, SlotKVCache]:
+    """Dense single-pass prefill of ONE slot of the shared cache.
+
+    ``prompt``: [P] int32 padded to a bucket width; ``length``: the true
+    token count (traced, so one compiled program serves every prompt
+    length ≤ P); ``slot``: traced slot index. Returns the logits at
+    position ``length - 1`` ([vocab]) and the cache with rows [0, P) of
+    that slot rewritten and ``pos[slot] = length`` — other slots'
+    rows/positions are untouched, so admission never disturbs a live
+    request mid-decode. Rows ≥ ``length`` hold pad garbage; they are
+    masked by ``pos`` and each is overwritten by a later decode step
+    before ``pos`` ever reaches it.
+    """
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def c(x):
+        return _cast(x, cd)
+
+    embed, pos_emb = params[0], params[1]
+    P = prompt.shape[0]
+    dh = cfg.d_model // cfg.n_heads
+    h = c(embed[prompt] + pos_emb[:P])  # [P, d] — a slot starts at 0
+    scale = dh**-0.5
+    causal = (
+        jnp.arange(P)[None, :] <= jnp.arange(P)[:, None]
+    )  # [P, P]
+
+    new_k, new_v = cache.k, cache.v
+    idx = 2
+    for layer in range(cfg.n_layers):
+
+        def attn(x, wq, wk, wv, layer=layer):
+            nonlocal new_k, new_v
+            q = (x @ wq).reshape(P, cfg.n_heads, dh)
+            # round k/v through the CACHE dtype before attending — the
+            # decode steps read these rows post-rounding, and a narrowed
+            # cache (bf16) must see identical values from prefill and
+            # decode or the bit-identical-greedy contract breaks
+            k = (x @ wk).reshape(P, cfg.n_heads, dh).astype(new_k.dtype)
+            v = (x @ wv).reshape(P, cfg.n_heads, dh).astype(new_v.dtype)
+            new_k = lax.dynamic_update_slice(
+                new_k, k[None, None], (layer, slot, 0, 0, 0)
+            )
+            new_v = lax.dynamic_update_slice(
+                new_v, v[None, None], (layer, slot, 0, 0, 0)
+            )
+            # attention stays within the prompt: a fresh slot has no
+            # earlier rows, so the [P, P] causal pass never reads the
+            # shared cache
+            s = jnp.einsum(
+                "phd,lhd->hpl", q, k, preferred_element_type=jnp.float32
+            ) * scale
+            s = jnp.where(causal[None, :, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum(
+                "hpl,lhd->phd", p.astype(v.dtype), v,
+                preferred_element_type=jnp.float32,
+            ).reshape(P, cfg.d_model)
+
+        h = _block(h, params[idx : idx + PARAMS_PER_LAYER], c, attn)
+        idx += PARAMS_PER_LAYER
+    h_last = lax.dynamic_index_in_dim(
+        h, length - 1, axis=0, keepdims=False
+    )
+    h_last = _ln(h_last, params[idx], params[idx + 1])
+    logits = jnp.dot(
+        c(h_last), c(embed).T, preferred_element_type=jnp.float32
+    )
+    return logits, SlotKVCache(
+        k=new_k, v=new_v, pos=cache.pos.at[slot].set(length)
+    )
+
+
+def decode_step_slots(
+    params: Sequence[jax.Array],
+    cache: SlotKVCache,
+    token: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    compute_dtype: Any | None = None,
+) -> tuple[jax.Array, SlotKVCache]:
+    """One decode step for the first ``w = token.shape[0]`` slots of the
+    shared cache, each at its OWN position ``cache.pos[s]`` → (logits
+    [w, vocab] f32, cache with one row appended per advanced slot).
+
+    ``w`` may be smaller than S (the engine's width buckets: compile once
+    per bucket, not per live-request count); slots ≥ w are untouched.
+    Free slots inside the width write a garbage row at their stale
+    position — harmless, because a slot's rows are only ever read below
+    its own ``pos`` and a joining request rewrites [0, length) first.
+    """
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def c(x):
+        return _cast(x, cd)
+
+    embed, pos_emb = params[0], params[1]
+    w = token.shape[0]
+    dh = cfg.d_model // cfg.n_heads
+    t = cache.pos[:w]  # [w] per-slot positions
+    slots = jnp.arange(w)
+    h = c(embed[token] + pos_emb[t])  # [w, d]
+    #: slot s may read rows [0, t_s] — its own history plus the k/v this
+    #: step writes; rows of OTHER slots are unreachable by construction
+    #: (the attention below is batched per slot, never cross-slot)
+    mask = jnp.arange(cfg.max_len)[None, :] <= t[:, None]  # [w, max_len]
+    scale = dh**-0.5
+
+    new_k, new_v = cache.k, cache.v
+    idx = 2
+    for layer in range(cfg.n_layers):
+
+        def attn(x, wq, wk, wv, layer=layer):
+            nonlocal new_k, new_v
+            q = (x @ wq).reshape(w, cfg.n_heads, dh)
+            k = (x @ wk).reshape(w, cfg.n_heads, dh)
+            v = (x @ wv).reshape(w, cfg.n_heads, dh)
+            new_k = new_k.at[layer, slots, t].set(k.astype(new_k.dtype))
+            new_v = new_v.at[layer, slots, t].set(v.astype(new_v.dtype))
+            s = jnp.einsum(
+                "whd,wlhd->whl", q, new_k[layer, :w],
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(mask[:, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum(
+                "whl,wlhd->whd", p.astype(new_v.dtype), new_v[layer, :w],
+                preferred_element_type=jnp.float32,
+            ).reshape(w, cfg.d_model)
+
+        h = _block(h, params[idx : idx + PARAMS_PER_LAYER], c, attn)
+        idx += PARAMS_PER_LAYER
+    h = _ln(h, params[idx], params[idx + 1])
+    logits = jnp.dot(
+        c(h), c(embed).T, preferred_element_type=jnp.float32
+    )
+    new_pos = cache.pos.at[:w].add(1)
+    return logits, SlotKVCache(k=new_k, v=new_v, pos=new_pos)
 
 
 def generate(
@@ -200,9 +448,9 @@ def generate(
     ``temperature == 0``: greedy argmax. Otherwise softmax sampling at
     the given temperature (``key`` required); ``temperature`` may be a
     traced scalar when sampling, so one jitted program serves every
-    temperature. The prefill and the decode loop are each one
-    ``lax.scan`` — the whole call jits to a single XLA program with a
-    static-shape cache. ``cache_dtype`` narrows the KV cache itself
+    temperature. The prefill is one dense causal pass and the decode
+    loop is one ``lax.scan`` — the whole call jits to a single XLA
+    program with a static-shape cache. ``cache_dtype`` narrows the KV cache itself
     (decode is bandwidth-bound on the cache read, so bf16 halves the
     per-step sweep); defaults to ``compute_dtype`` when that is set,
     else f32. Exactly ``n_new - 1`` decode steps run after prefill —

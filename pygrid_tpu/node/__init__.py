@@ -82,6 +82,20 @@ class NodeContext:
         self.models = ModelController(self.kv)
         self.sessions = SessionsRepository()
         self.users = UserManager(self.db, secret_key=self.secret_key)
+        # continuous-batching generation engines, one per hosted
+        # transformer bundle (pygrid_tpu/serving, docs/SERVING.md) —
+        # cheap to construct (engines build lazily on first request);
+        # slot/queue depth are the ops sizing knobs
+        import os
+
+        from pygrid_tpu.serving import EngineConfig, ServingManager
+
+        self.serving = ServingManager(
+            EngineConfig(
+                max_slots=int(os.environ.get("PYGRID_SERVING_SLOTS", "8")),
+                max_queue=int(os.environ.get("PYGRID_SERVING_QUEUE", "64")),
+            )
+        )
 
     def all_stores(self):
         """The node's singleton store plus every live session worker's store —
@@ -126,6 +140,13 @@ def create_app(
         middlewares=[telemetry.http_middleware()],
     )
     app["node"] = ctx
+
+    async def _close_serving(app):
+        # stop the generation engines' worker threads with the app —
+        # queued requests fail typed instead of hanging on a dead server
+        app["node"].serving.close()
+
+    app.on_cleanup.append(_close_serving)
     app.router.add_get("/", ws_handler)  # WS upgrade or landing JSON
     R.register(app)
     return app

@@ -46,6 +46,11 @@ PAGE = """<!doctype html>
 <tr><th>cycle</th><th>seq</th><th>reports</th><th>stragglers</th>
 <th>aggregate (ms)</th><th>outcome</th></tr>
 </thead><tbody></tbody></table>
+<h2>Generation serving</h2>
+<table id="serving"><thead>
+<tr><th>model</th><th>queue</th><th>slots live</th><th>requests</th>
+<th>tokens</th><th>compiles</th></tr>
+</thead><tbody></tbody></table>
 <script>
 function row(fields) {{
   const tr = document.createElement('tr');
@@ -111,6 +116,22 @@ async function refresh() {{
         c.stragglers ?? '—',
         agg !== undefined ? (agg * 1000).toFixed(1) : '—',
         c.outcome || 'open']));
+    }}
+    const sv = await (await fetch('/telemetry/serving')).json();
+    const svBody = document.querySelector('#serving tbody');
+    svBody.replaceChildren();
+    const engines = sv.engines || [];
+    if (!engines.length) {{
+      const tr = document.createElement('tr');
+      const td = document.createElement('td');
+      td.colSpan = 6; td.className = 'muted'; td.textContent = 'none';
+      tr.appendChild(td); svBody.appendChild(tr);
+    }}
+    for (const e of engines) {{
+      svBody.appendChild(row([
+        e.model_id, e.queue_depth,
+        e.live_slots + '/' + e.max_slots,
+        e.requests_total, e.tokens_total, e.compiles_total]));
     }}
   }} catch (err) {{
     document.getElementById('status').textContent = 'error: ' + err;

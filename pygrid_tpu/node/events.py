@@ -549,104 +549,177 @@ def _generation_fn(cfg, n_new: int, seeded: bool):
     return fn
 
 
+def _prepare_generation(ctx: NodeContext, message: dict):
+    """Validate a run-generation message end to end. Returns either an
+    error-response dict or ``(hosted, prompt, n_new, temperature,
+    seed)`` with the hosted bundle parsed into
+    ``hosted.generation_cache``. Shared by the WS handler and the async
+    HTTP route so the two doors cannot drift on the typed-error
+    contract."""
+    import math
+
+    import numpy as np
+
+    got = _servable_and_data(ctx, message)
+    if isinstance(got, dict):
+        return got
+    hosted, prompt = got
+    from pygrid_tpu.models import decode
+
+    # parse + device-upload the bundle ONCE per hosted model (the
+    # HostedModel lives in the process-wide ModelCache, so every
+    # later request reuses the on-device params)
+    if hosted.generation_cache is None:
+        hosted.generation_cache = decode.from_bundle(hosted.model)
+    cfg, _params = hosted.generation_cache
+    prompt = np.asarray(prompt)
+    if (
+        prompt.ndim != 2
+        or prompt.shape[0] < 1
+        or prompt.shape[1] < 1
+        or not np.issubdtype(prompt.dtype, np.integer)
+    ):
+        return {
+            SUCCESS: False,
+            ERROR: "prompt must be non-empty int tokens [B, P]",
+        }
+    # bound what the untrusted B actually sizes — per-request KV work is
+    # 2 × [layers, B, max_len, H, dh] (B is the only request-controlled
+    # factor; the rest is the hosted config), so the cap is on total
+    # cache elements, mirroring the MAX_OPLIST_ELEMENTS posture in
+    # plans/translators.py. The batch engine's cache is allocated per
+    # SLOT, not per request, but the same cap bounds how many rows one
+    # frame may enqueue.
+    cache_elems = (
+        2 * cfg.n_layers * prompt.shape[0] * cfg.max_len * cfg.d_model
+    )
+    if cache_elems > _MAX_GENERATION_CACHE_ELEMENTS:
+        return {
+            SUCCESS: False,
+            ERROR: (
+                f"prompt batch of {prompt.shape[0]} would need a "
+                f"{cache_elems:,}-element KV cache (cap "
+                f"{_MAX_GENERATION_CACHE_ELEMENTS:,})"
+            ),
+        }
+    if prompt.min() < 0 or prompt.max() >= cfg.vocab:
+        return {
+            SUCCESS: False,
+            ERROR: f"prompt token out of range [0, {cfg.vocab})",
+        }
+    raw_n_new = message.get("n_new", 16)
+    # same wire contract as temperature below: a JSON integer — bools,
+    # strings ("8" would int()-coerce) and fractional floats all bounce
+    if (
+        isinstance(raw_n_new, bool)
+        or not isinstance(raw_n_new, (int, float))
+        or (isinstance(raw_n_new, float) and not math.isfinite(raw_n_new))
+        or int(raw_n_new) != raw_n_new
+    ):
+        return {SUCCESS: False, ERROR: "n_new must be a JSON integer"}
+    n_new = int(raw_n_new)
+    if n_new < 1:
+        return {SUCCESS: False, ERROR: "n_new must be >= 1"}
+    raw_temp = message.get("temperature", 0.0)
+    if isinstance(raw_temp, bool) or not isinstance(
+        raw_temp, (int, float)
+    ):
+        # float() would coerce JSON true to 1.0 (silently sampling) and
+        # numeric strings to their value — the wire contract is a JSON
+        # number, everything else bounces typed
+        return {
+            SUCCESS: False,
+            ERROR: "temperature must be a JSON number (bool/string rejected)",
+        }
+    temperature = float(raw_temp)
+    # `== 0 or > 0` rejects both negatives AND NaN (NaN fails both);
+    # isfinite rejects Infinity, which would otherwise collapse the
+    # logits to zero and silently serve uniform-random tokens
+    if not math.isfinite(temperature) or not (
+        temperature == 0.0 or temperature > 0.0
+    ):
+        return {SUCCESS: False, ERROR: "temperature must be finite and >= 0"}
+    seed = message.get("seed")
+    if seed is not None:
+        if (
+            isinstance(seed, bool)
+            or not isinstance(seed, (int, float))
+            or (isinstance(seed, float) and not math.isfinite(seed))
+            or int(seed) != seed
+        ):
+            return {SUCCESS: False, ERROR: "seed must be a JSON integer"}
+        seed = int(seed)
+        # PRNGKey overflows int64 with an uncaught OverflowError —
+        # bound the client-supplied value to the typed-error contract
+        if not 0 <= seed < 2**63:
+            return {
+                SUCCESS: False,
+                ERROR: "seed must be in [0, 2**63)",
+            }
+    return hosted, prompt, n_new, temperature, seed
+
+
+def _legacy_generate(hosted, prompt, n_new: int, temperature, seed):
+    """The pre-engine per-request path (one whole-generation XLA program
+    jitted per distinct ``n_new``) — kept as the ``PYGRID_SERVING=off``
+    escape hatch and as the baseline ``bench_serving`` measures the
+    batch engine against."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cfg, params = hosted.generation_cache
+    if temperature > 0.0 and seed is None:
+        # unseeded sampling must actually vary across requests
+        seed = int.from_bytes(os.urandom(4), "big")
+    sampled = temperature > 0.0
+    fn = _generation_fn(cfg, n_new, sampled)
+    if sampled:
+        toks = fn(
+            params,
+            jnp.asarray(prompt),
+            jax.random.PRNGKey(int(seed)),
+            jnp.float32(temperature),
+        )
+    else:
+        toks = fn(params, jnp.asarray(prompt))
+    return np.asarray(toks)
+
+
 def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
-    """Autoregressive generation from a hosted transformer bundle
-    (``models/decode.py``) — the serving twin of ``run_inference`` for
-    the generative model family. Message fields: ``model_id``, ``data``
-    (serialized int prompt [B, P]), ``n_new``, optional ``temperature``
-    + ``seed``. Gated by the same ``allow_remote_inference`` flag. No
-    reference analog (its inference surface is feed-forward only)."""
+    """Autoregressive generation from a hosted transformer bundle —
+    the serving twin of ``run_inference`` for the generative model
+    family. Message fields: ``model_id``, ``data`` (serialized int
+    prompt [B, P]), ``n_new``, optional ``temperature`` + ``seed``.
+    Gated by the same ``allow_remote_inference`` flag.
+
+    Since the serving engine (``pygrid_tpu/serving/``, docs/SERVING.md)
+    this handler is a thin enqueue-and-await wrapper: the request joins
+    the model's continuous batch and this (executor) thread blocks on
+    the result future while the engine's dedicated thread drives the
+    device — concurrent requests share one persistent batched program
+    instead of serializing whole-generation XLA calls, and a full queue
+    answers a typed busy error instead of piling up. Greedy results are
+    bit-identical to the direct ``decode.generate`` path;
+    ``PYGRID_SERVING=off`` restores the legacy per-request programs."""
     _authenticated(conn)
     import numpy as np
 
     try:
-        got = _servable_and_data(ctx, message)
-        if isinstance(got, dict):
-            return got
-        hosted, prompt = got
-        from pygrid_tpu.models import decode
-
-        # parse + device-upload the bundle ONCE per hosted model (the
-        # HostedModel lives in the process-wide ModelCache, so every
-        # later request reuses the on-device params)
-        if hosted.generation_cache is None:
-            hosted.generation_cache = decode.from_bundle(hosted.model)
-        cfg, params = hosted.generation_cache
-        prompt = np.asarray(prompt)
-        if (
-            prompt.ndim != 2
-            or prompt.shape[0] < 1
-            or prompt.shape[1] < 1
-            or not np.issubdtype(prompt.dtype, np.integer)
-        ):
-            return {
-                SUCCESS: False,
-                ERROR: "prompt must be non-empty int tokens [B, P]",
-            }
-        # bound what the untrusted B actually sizes — the KV cache is
-        # 2 × [layers, B, max_len, H, dh] (B is the only request-
-        # controlled factor; the rest is the hosted config), so the cap
-        # is on total cache elements, mirroring the MAX_OPLIST_ELEMENTS
-        # posture in plans/translators.py
-        cache_elems = (
-            2 * cfg.n_layers * prompt.shape[0] * cfg.max_len * cfg.d_model
-        )
-        if cache_elems > _MAX_GENERATION_CACHE_ELEMENTS:
-            return {
-                SUCCESS: False,
-                ERROR: (
-                    f"prompt batch of {prompt.shape[0]} would need a "
-                    f"{cache_elems:,}-element KV cache (cap "
-                    f"{_MAX_GENERATION_CACHE_ELEMENTS:,})"
-                ),
-            }
-        if prompt.min() < 0 or prompt.max() >= cfg.vocab:
-            return {
-                SUCCESS: False,
-                ERROR: f"prompt token out of range [0, {cfg.vocab})",
-            }
-        n_new = int(message.get("n_new", 16))
-        if n_new < 1:
-            return {SUCCESS: False, ERROR: "n_new must be >= 1"}
-        import math
-
-        temperature = float(message.get("temperature", 0.0))
-        # `== 0 or > 0` rejects both negatives AND NaN (NaN fails both);
-        # isfinite rejects Infinity, which would otherwise collapse the
-        # logits to zero and silently serve uniform-random tokens
-        if not math.isfinite(temperature) or not (
-            temperature == 0.0 or temperature > 0.0
-        ):
-            return {SUCCESS: False, ERROR: "temperature must be finite and >= 0"}
-        seed = message.get("seed")
-        if seed is not None:
-            seed = int(seed)
-            # PRNGKey overflows int64 with an uncaught OverflowError —
-            # bound the client-supplied value to the typed-error contract
-            if not 0 <= seed < 2**63:
-                return {
-                    SUCCESS: False,
-                    ERROR: "seed must be in [0, 2**63)",
-                }
-
-        import jax
-        import jax.numpy as jnp
-
-        if temperature > 0.0 and seed is None:
-            # unseeded sampling must actually vary across requests
-            seed = int.from_bytes(os.urandom(4), "big")
-        sampled = temperature > 0.0
-        fn = _generation_fn(cfg, n_new, sampled)
-        if sampled:
-            toks = fn(
-                params,
-                jnp.asarray(prompt),
-                jax.random.PRNGKey(int(seed)),
-                jnp.float32(temperature),
-            )
+        prep = _prepare_generation(ctx, message)
+        if isinstance(prep, dict):
+            return prep
+        hosted, prompt, n_new, temperature, seed = prep
+        if os.environ.get("PYGRID_SERVING", "").lower() in ("off", "0"):
+            toks = _legacy_generate(hosted, prompt, n_new, temperature, seed)
         else:
-            toks = fn(params, jnp.asarray(prompt))
+            engine = ctx.serving.engine_for(
+                str(message[MSG_FIELD.MODEL_ID]), hosted
+            )
+            toks = engine.submit(prompt, n_new, temperature, seed)
         return {SUCCESS: True, "tokens": np.asarray(toks).tolist()}
+    except E.ServerBusyError as err:
+        return {SUCCESS: False, "busy": True, ERROR: str(err)}
     except (E.PyGridError, ValueError, TypeError) as err:
         return {SUCCESS: False, ERROR: str(err)}
 
@@ -654,7 +727,13 @@ def run_generation(ctx: NodeContext, message: dict, conn: Connection) -> dict:
 def delete_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     _authenticated(conn)
     try:
-        return ctx.models.delete(ctx.local_worker.id, message[MSG_FIELD.MODEL_ID])
+        result = ctx.models.delete(
+            ctx.local_worker.id, message[MSG_FIELD.MODEL_ID]
+        )
+        # the serving engine holds the bundle's device params + slot
+        # cache — deleting the model must release them
+        ctx.serving.evict(str(message[MSG_FIELD.MODEL_ID]))
+        return result
     except E.PyGridError as err:
         return {SUCCESS: False, ERROR: str(err)}
 

@@ -46,6 +46,8 @@ def _json_error(err: Exception, status: int) -> web.Response:
 
 
 def _status_for(err: Exception) -> int:
+    if isinstance(err, E.ServerBusyError):
+        return 503  # backpressure: retryable, not a client defect
     if isinstance(err, E.InvalidRequestKeyError):
         return 401
     if isinstance(
@@ -489,6 +491,16 @@ async def metrics(request: web.Request) -> web.Response:
                     "cumulative seconds per timed section", labels)
         exp.counter("timing_invocations_total", rec["count"],
                     "invocations per timed section", labels)
+    # serving engines: point-in-time gauges (the counters/histograms —
+    # TTFT, per-token latency, occupancy, compiles — ride the bus below)
+    for eng in ctx.serving.stats():
+        labels = {"model": eng["model_id"]}
+        exp.gauge("serving_queue_depth", eng["queue_depth"],
+                  "generation rows waiting for a slot", labels)
+        exp.gauge("serving_live_slots", eng["live_slots"],
+                  "generation slots decoding right now", labels)
+        exp.gauge("serving_max_slots", eng["max_slots"],
+                  "generation slots in the shared KV cache", labels)
     # the telemetry bus: event counters + every histogram family
     # (request latency by route, frame decode time, report latency,
     # cycle phases, wire bytes by codec, serde tensor copies)
@@ -557,6 +569,64 @@ async def dc_serve_model(request: web.Request) -> web.Response:
         return web.json_response(result)
     except Exception as err:  # noqa: BLE001 — HTTP boundary
         return _json_error(err, _status_for(err))
+
+
+async def dc_run_generation(request: web.Request) -> web.Response:
+    """HTTP door into the continuous-batching generation engine
+    (docs/SERVING.md) — a genuinely async enqueue-and-await: the
+    request's rows join the model's batch and the event loop awaits the
+    engine future directly, so a slow generation holds no executor
+    thread at all. Body mirrors the WS ``run-generation`` event
+    (``model_id``, base64 ``data``, ``n_new``, ``temperature``,
+    ``seed``); session token via the ``token`` header. A full queue is
+    503, validation defects are 400 — same typed messages as the WS
+    twin (both doors share ``_prepare_generation``)."""
+    import asyncio
+
+    from pygrid_tpu.node.events import _prepare_generation
+
+    ctx = _ctx(request)
+    try:
+        _dc_session(request)
+        body = json.loads(await request.text())
+        loop = asyncio.get_running_loop()
+        # validation deserializes the (possibly large) prompt blob —
+        # off the event loop like every other blocking handler
+        prep = await loop.run_in_executor(
+            None, _prepare_generation, ctx, body
+        )
+        if isinstance(prep, dict):
+            return web.json_response(prep, status=400)
+        hosted, prompt, n_new, temperature, seed = prep
+        engine = ctx.serving.engine_for(
+            str(body[MSG_FIELD.MODEL_ID]), hosted
+        )
+        future = engine.enqueue(prompt, n_new, temperature, seed)
+        tokens = await asyncio.wait_for(
+            asyncio.wrap_future(future),
+            timeout=engine.config.default_timeout_s,
+        )
+        return web.json_response(
+            {"success": True, "tokens": tokens.tolist()}
+        )
+    except asyncio.TimeoutError:
+        return _json_error(
+            E.PyGridError("generation timed out awaiting the batch engine"),
+            504,
+        )
+    except (json.JSONDecodeError, ValueError, TypeError) as err:
+        # same client-defect class the WS door answers typed (e.g.
+        # n_new="abc", undecodable data blob) — a 400, never a 500
+        return _json_error(err, 400)
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+
+
+async def telemetry_serving(request: web.Request) -> web.Response:
+    """Per-engine serving gauges (queue depth, live slots, totals) —
+    the dashboard's poll; histograms (TTFT, per-token latency, batch
+    occupancy) are on /metrics."""
+    return web.json_response({"engines": _ctx(request).serving.stats()})
 
 
 async def dc_dataset_tags(request: web.Request) -> web.Response:
@@ -680,6 +750,8 @@ def register(app: web.Application) -> None:
     r.add_get("/telemetry/cycles", telemetry_cycles)
     r.add_get("/telemetry/cycles/{id}", telemetry_cycle_detail)
     r.add_get("/telemetry/events", telemetry_events)
+    r.add_get("/telemetry/serving", telemetry_serving)
+    r.add_post("/data-centric/run-generation", dc_run_generation)
     r.add_get("/data-centric/status/", dc_status)
     r.add_get("/data-centric/workers/", dc_workers)
     r.add_post("/data-centric/serve-model/", dc_serve_model)

@@ -19,7 +19,9 @@ configuration.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 from aiohttp import WSMsgType, web
 
@@ -38,6 +40,21 @@ from pygrid_tpu.telemetry import trace
 #: every subprotocol variant this build can serve — aiohttp picks the
 #: first of the client's offers present here (client preference wins)
 _SERVER_SUBPROTOCOLS = tuple(offered_subprotocols("auto"))
+
+#: dedicated bounded pool for WS handler work, replacing the loop's
+#: default executor: generation COMPUTE now runs on each serving
+#: engine's own thread (pygrid_tpu/serving), so a generation burst can
+#: no longer monopolize the process-wide default executor that other
+#: subsystems share. A generation frame still *occupies* one of these
+#: threads while it waits on the engine future (each WS connection
+#: processes one frame at a time, so that's one thread per generating
+#: client) — deployments expecting more than PYGRID_WS_THREADS
+#: concurrent generating sockets should raise the knob or point bulk
+#: generation at the async HTTP route, which holds no thread at all.
+_WS_EXECUTOR = ThreadPoolExecutor(
+    max_workers=int(os.environ.get("PYGRID_WS_THREADS", "32")),
+    thread_name_prefix="pygrid-ws",
+)
 
 
 async def ws_handler(request: web.Request) -> web.StreamResponse:
@@ -129,7 +146,9 @@ async def ws_handler(request: web.Request) -> web.StreamResponse:
                 # the megabyte report path; handlers never mutate frames
             else:
                 continue
-            response = await loop.run_in_executor(None, _process, payload)
+            response = await loop.run_in_executor(
+                _WS_EXECUTOR, _process, payload
+            )
             try:
                 if isinstance(response, (bytes, bytearray, memoryview)):
                     await ws.send_bytes(bytes(response))

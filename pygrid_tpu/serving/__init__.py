@@ -1,0 +1,96 @@
+"""Continuous-batching generation serving (see docs/SERVING.md).
+
+The node's ``run-generation`` surface routes through this package: a
+:class:`ServingManager` holds one :class:`GenerationEngine` per hosted
+transformer bundle, and each engine serves many concurrent requests
+from one persistent slot-structured KV cache with a fixed, bucketed set
+of compiled programs — the inference-side counterpart of the wire-v2
+hot-loop work (CHANGES.md PR 1).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any
+
+from pygrid_tpu.serving.engine import EngineConfig, GenerationEngine
+from pygrid_tpu.serving.programs import (
+    ProgramSet,
+    prompt_buckets,
+    width_buckets,
+)
+
+__all__ = [
+    "EngineConfig",
+    "GenerationEngine",
+    "ProgramSet",
+    "ServingManager",
+    "prompt_buckets",
+    "width_buckets",
+]
+
+
+class ServingManager:
+    """Node-wide registry: hosted model id → its generation engine.
+
+    Engines build lazily on first generation request (parsing the bundle
+    and allocating the slot cache is paid once, not per request) and
+    rebuild when a model id is re-hosted with new content — staleness is
+    detected by HostedModel object identity (a re-host constructs a new
+    object), tracked with a weakref so the registry never pins a deleted
+    model's params in memory."""
+
+    def __init__(self, config: EngineConfig | None = None) -> None:
+        self.config = config or EngineConfig()
+        self._engines: dict[str, tuple[Any, GenerationEngine]] = {}
+        self._lock = threading.Lock()
+
+    def engine_for(self, model_id: str, hosted) -> GenerationEngine:
+        """The live engine for ``hosted`` (building/rebuilding outside
+        the registry lock — compiles must not serialize other models'
+        lookups)."""
+        with self._lock:
+            entry = self._engines.get(model_id)
+            if entry is not None and entry[0]() is hosted:
+                return entry[1]
+        from pygrid_tpu.models import decode
+
+        if hosted.generation_cache is None:
+            hosted.generation_cache = decode.from_bundle(hosted.model)
+        cfg, params = hosted.generation_cache
+        engine = GenerationEngine(
+            cfg, params, config=self.config, model_id=str(model_id)
+        )
+        with self._lock:
+            entry = self._engines.get(model_id)
+            if entry is not None and entry[0]() is hosted:
+                # lost the build race — serve the winner, drop ours
+                winner, stale = entry[1], engine
+            else:
+                # fresh id, or the id was re-hosted: swap the stale
+                # engine out (its params belong to the old checkpoint)
+                winner, stale = engine, entry[1] if entry else None
+                self._engines[model_id] = (weakref.ref(hosted), engine)
+        if stale is not None:
+            stale.close()
+        return winner
+
+    def evict(self, model_id: str) -> None:
+        """Drop (and stop) the engine for a deleted/re-hosted model."""
+        with self._lock:
+            entry = self._engines.pop(model_id, None)
+        if entry is not None:
+            entry[1].close()
+
+    def stats(self) -> list[dict]:
+        with self._lock:
+            engines = [e for _, e in self._engines.values()]
+        return [e.stats() for e in engines]
+
+    def close(self) -> None:
+        with self._lock:
+            engines = [e for _, e in self._engines.values()]
+            self._engines.clear()
+        for engine in engines:
+            engine.close()

@@ -1,0 +1,540 @@
+"""Slot-based continuous-batching generation engine.
+
+One :class:`GenerationEngine` serves one hosted transformer bundle. It
+owns a persistent :class:`~pygrid_tpu.models.decode.SlotKVCache` of
+``max_slots`` request slots and a dedicated worker thread that runs the
+device loop — the Orca-style continuous-batching core (Yu et al., OSDI
+'22; slot cache after Kwon et al., SOSP '23):
+
+- requests wait in a bounded FIFO queue (admission past the depth limit
+  answers a typed :class:`~pygrid_tpu.utils.exceptions.ServerBusyError`
+  — backpressure, not an unbounded pile-up);
+- a free slot admits the oldest request via a per-slot dense prefill
+  (prompt padded to a bucket, true length traced) that rewrites only
+  that slot — live slots keep decoding undisturbed;
+- every step advances ALL live slots with one jitted decode program at
+  the narrowest width bucket covering them, each slot at its own
+  position — finished requests leave between steps while the rest keep
+  decoding, so short requests never wait for long ones;
+- at most ``quantum`` decode steps run between admission checks (the
+  fairness cap: a queued request's time-to-first-token is bounded by
+  one quantum even when the batch is full of long generations).
+
+Greedy results are bit-identical to single-request
+:func:`pygrid_tpu.models.decode.generate` (tested); sampling is
+reproducible per (seed, row) and distribution-identical to the
+single-request path. The worker thread is the ONLY thread that touches
+the device loop — WS/HTTP handler threads just enqueue and wait on a
+future, so heavy generation cannot starve FL report handlers on the
+shared executor.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from pygrid_tpu import telemetry
+from pygrid_tpu.serving.programs import (
+    ProgramSet,
+    prompt_buckets,
+    width_buckets,
+)
+from pygrid_tpu.utils import exceptions as E
+
+logger = logging.getLogger(__name__)
+
+#: occupancy histogram bucket bounds: one bucket per live-slot count
+#: (the seconds ladder the bus defaults to is wrong for small integers)
+_OCCUPANCY_BOUNDS = [float(i) for i in range(1, 17)]
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Engine shape knobs. ``slot_buckets`` are decode widths to compile
+    (always topped up with ``max_slots``); prompt buckets derive from
+    the model's ``max_len`` (see :func:`programs.prompt_buckets`)."""
+
+    max_slots: int = 8
+    slot_buckets: tuple[int, ...] = (1, 4, 8)
+    min_prompt_bucket: int = 16
+    max_queue: int = 64
+    quantum: int = 8
+    default_timeout_s: float = 300.0
+    compute_dtype: Any = None
+    cache_dtype: Any = None
+
+
+class _Row:
+    """One sequence occupying (or waiting for) one slot — one row of a
+    client's [B, P] prompt."""
+
+    __slots__ = (
+        "pending", "row", "batch", "prompt", "n_new", "temperature",
+        "seed", "keys", "out", "last_token", "enqueued_at", "admitted_at",
+    )
+
+    def __init__(self, pending, row, batch, prompt, n_new, temperature, seed):
+        self.pending = pending
+        self.row = row
+        self.batch = batch
+        self.prompt = prompt  # np int32 [P]
+        self.n_new = n_new
+        self.temperature = temperature
+        self.seed = seed  # resolved (never None when sampling)
+        #: np uint32 [n_new, 2] when sampling — derived lazily on the
+        #: ENGINE thread at admission (PRNGKey/split are device calls;
+        #: they must not run on an enqueueing event-loop thread)
+        self.keys = None
+        self.out: list[int] = []
+        self.last_token = 0
+        self.enqueued_at = time.perf_counter()
+        self.admitted_at: float | None = None
+
+
+class _Pending:
+    """One client request: B rows + the future their reassembled
+    [B, n_new] tokens resolve."""
+
+    def __init__(self, batch: int, n_new: int) -> None:
+        self.future: Future = Future()
+        self.tokens = np.zeros((batch, n_new), np.int32)
+        self.remaining = batch
+
+    def finish_row(self, row: int, toks: list[int]) -> None:
+        self.tokens[row] = toks
+        self.remaining -= 1
+        if self.remaining == 0 and not self.future.done():
+            # done() covers both a waiter's cancel AND a racing
+            # _fail_all that already set an exception
+            self.future.set_result(self.tokens)
+
+
+class GenerationEngine:
+    """Continuous-batching server for one (config, params) bundle."""
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        config: EngineConfig | None = None,
+        model_id: str = "",
+    ) -> None:
+        import jax.numpy as jnp
+
+        from pygrid_tpu.models import decode
+
+        self.cfg = cfg
+        self.model_id = model_id
+        self.config = config or EngineConfig()
+        self.params = params
+        self.programs = ProgramSet(
+            cfg,
+            compute_dtype=self.config.compute_dtype,
+            cache_dtype=self.config.cache_dtype,
+        )
+        self._prompt_buckets = prompt_buckets(
+            cfg.max_len, self.config.min_prompt_bucket
+        )
+        self._widths = width_buckets(
+            self.config.max_slots, self.config.slot_buckets
+        )
+        self._kv_dtype = (
+            self.config.cache_dtype
+            if self.config.cache_dtype is not None
+            else (
+                self.config.compute_dtype
+                if self.config.compute_dtype is not None
+                else jnp.float32
+            )
+        )
+        cache = decode.init_slot_cache(
+            cfg, self.config.max_slots, dtype=self._kv_dtype
+        )
+        # held as separate refs: the jitted programs donate and return
+        # them, and the engine swaps in the new buffers every call
+        self._k, self._v, self._pos = cache.k, cache.v, cache.pos
+        self._slots: list[_Row | None] = [None] * self.config.max_slots
+        self._queue: deque[_Row] = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._running = True
+        self._live = 0
+        self._thread: threading.Thread | None = None
+        self._requests = 0
+        self._tokens_out = 0
+
+    # ── client surface (any thread) ─────────────────────────────────────
+
+    def enqueue(
+        self,
+        prompt: np.ndarray,
+        n_new: int,
+        temperature: float = 0.0,
+        seed: int | None = None,
+    ) -> Future:
+        """Queue a [B, P] int prompt for generation; resolves to int32
+        tokens [B, n_new]. Raises :class:`ServerBusyError` when the
+        queue is at depth — callers translate it to the typed wire
+        error. Validation (shape, vocab range, cache caps, temperature/
+        seed domains) is the caller's job: this is the hot path."""
+        prompt = np.asarray(prompt, np.int32)
+        batch, p_len = prompt.shape
+        if p_len + n_new > self.cfg.max_len:
+            raise E.PyGridError(
+                f"prompt ({p_len}) + n_new ({n_new}) exceeds max_len "
+                f"({self.cfg.max_len})"
+            )
+        if batch > self.config.max_queue:
+            # a batch that can never fit is a client defect, not
+            # backpressure — ServerBusyError would invite infinite
+            # retries against a permanent condition
+            raise E.PyGridError(
+                f"prompt batch of {batch} rows exceeds the engine queue "
+                f"capacity ({self.config.max_queue})"
+            )
+        if float(temperature) > 0.0 and seed is None:
+            # unseeded sampling must still vary across requests (plain
+            # urandom here: key derivation happens on the engine thread)
+            import os
+
+            seed = int.from_bytes(os.urandom(4), "big")
+        pending = _Pending(batch, n_new)
+        rows = [
+            _Row(
+                pending, b, batch, prompt[b], n_new, float(temperature),
+                seed,
+            )
+            for b in range(batch)
+        ]
+        with self._work:
+            if not self._running:
+                raise E.PyGridError("generation engine is closed")
+            if len(self._queue) + batch > self.config.max_queue:
+                telemetry.incr(
+                    "serving_requests_total", outcome="busy",
+                    model=self.model_id,
+                )
+                raise E.ServerBusyError(
+                    f"generation queue full ({len(self._queue)} rows "
+                    f"queued, depth limit {self.config.max_queue}) — "
+                    "retry later"
+                )
+            self._queue.extend(rows)
+            self._requests += 1
+            self._ensure_thread()
+            self._work.notify()
+        return pending.future
+
+    def submit(
+        self,
+        prompt: np.ndarray,
+        n_new: int,
+        temperature: float = 0.0,
+        seed: int | None = None,
+        timeout: float | None = None,
+    ) -> np.ndarray:
+        """Blocking :meth:`enqueue` — the WS handler's enqueue-and-await
+        wrapper (handler threads wait here; the device loop stays on the
+        engine thread)."""
+        future = self.enqueue(prompt, n_new, temperature, seed)
+        try:
+            return future.result(
+                timeout if timeout is not None
+                else self.config.default_timeout_s
+            )
+        except FutureTimeoutError:
+            telemetry.incr(
+                "serving_requests_total", outcome="timeout",
+                model=self.model_id,
+            )
+            raise E.PyGridError(
+                "generation timed out awaiting the batch engine"
+            ) from None
+
+    def stats(self) -> dict:
+        """Live gauges for /metrics, /telemetry/serving and the
+        dashboard."""
+        with self._lock:
+            return {
+                "model_id": self.model_id,
+                "queue_depth": len(self._queue),
+                "live_slots": self._live,
+                "max_slots": self.config.max_slots,
+                "requests_total": self._requests,
+                "tokens_total": self._tokens_out,
+                "compiles_total": self.programs.compile_count(),
+            }
+
+    def compile_count(self) -> int:
+        return self.programs.compile_count()
+
+    def warmup(self, prompt_lens: tuple[int, ...] = ()) -> None:
+        """Compile AND execute the decode width buckets (and the prompt
+        buckets the given lengths land in) ahead of traffic, so the
+        first real request pays admission latency, not XLA compiles.
+        Must run before serving traffic (it drives the device directly;
+        with live slots it backs off to lazy compilation instead of
+        racing the engine thread for the donated cache buffers). The
+        garbage rows it writes land in free slots below their reset-at-
+        admission positions — invisible to every later request."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._live > 0 or self._queue:
+                return
+        zero_key = jnp.zeros((2,), jnp.uint32)
+        seen = set()
+        for p_len in prompt_lens or (1,):
+            bucket = self._prompt_bucket(p_len)
+            if bucket in seen:
+                continue
+            seen.add(bucket)
+            fn = self.programs.prefill(bucket)
+            _tok, self._k, self._v, self._pos = fn(
+                self.params, self._k, self._v, self._pos,
+                jnp.int32(0), jnp.zeros((bucket,), jnp.int32),
+                jnp.int32(1), jnp.float32(0.0), zero_key,
+            )
+        for w in self._widths:
+            fn = self.programs.decode(w)
+            _toks, self._k, self._v, self._pos = fn(
+                self.params, self._k, self._v, self._pos,
+                jnp.zeros((w,), jnp.int32), jnp.zeros((w,), jnp.float32),
+                jnp.zeros((w, 2), jnp.uint32),
+            )
+
+    def close(self) -> None:
+        """Stop the worker thread; queued/live requests fail typed."""
+        with self._work:
+            self._running = False
+            self._work.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10)
+            if thread.is_alive():
+                # a jitted call outlasted the join (e.g. a huge lazy
+                # compile) — the daemon thread will see _running=False
+                # at its next loop check; don't race it for the slots
+                logger.warning(
+                    "engine %s thread still busy at close; pending "
+                    "requests fail typed, thread exits at next step",
+                    self.model_id,
+                )
+        self._fail_all(
+            E.PyGridError("generation engine closed"), reset_cache=False
+        )
+
+    # ── the device loop (engine thread only) ────────────────────────────
+
+    def _ensure_thread(self) -> None:
+        # under self._lock
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop,
+                name=f"pygrid-serving-{self.model_id or 'engine'}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while self._running and not self._queue and self._live == 0:
+                    self._work.wait()
+                if not self._running:
+                    return
+            try:
+                self._admit()
+                steps = 0
+                while steps < self.config.quantum and self._live:
+                    freed = self._step()
+                    steps += 1
+                    if freed and self._queue:
+                        break  # a slot opened and someone's waiting
+            except Exception as err:  # noqa: BLE001 — device-loop boundary
+                logger.exception("serving engine step failed")
+                self._fail_all(
+                    E.PyGridError(f"generation engine error: {err}")
+                )
+
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                slot = next(
+                    (i for i, r in enumerate(self._slots) if r is None),
+                    None,
+                )
+                if slot is None:
+                    return
+                row = self._queue.popleft()
+                self._slots[slot] = row
+                self._live += 1
+            now = time.perf_counter()
+            row.admitted_at = now
+            telemetry.observe(
+                "serving_queue_wait_seconds", now - row.enqueued_at
+            )
+            if row.temperature > 0.0 and row.keys is None:
+                row.keys = self._row_keys(
+                    row.seed, row.row, row.batch, row.n_new
+                )
+            bucket = self._prompt_bucket(len(row.prompt))
+            padded = np.zeros(bucket, np.int32)
+            padded[: len(row.prompt)] = row.prompt
+            fn = self.programs.prefill(bucket)
+            t0 = time.perf_counter()
+            tok, self._k, self._v, self._pos = fn(
+                self.params, self._k, self._v, self._pos,
+                jnp.int32(slot), jnp.asarray(padded),
+                jnp.int32(len(row.prompt)),
+                jnp.float32(row.temperature),
+                self._key_for(row, 0),
+            )
+            first = int(tok)
+            telemetry.observe(
+                "serving_ttft_seconds", time.perf_counter() - row.enqueued_at
+            )
+            telemetry.observe(
+                "serving_prefill_seconds", time.perf_counter() - t0
+            )
+            self._emit(slot, row, first)
+
+    def _step(self) -> bool:
+        """One batched decode step over every live slot; returns True if
+        any slot freed (a finished request left the batch)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            # snapshot (index, row) pairs — never re-index self._slots
+            # after releasing the lock (a close() that outwaited its
+            # join could swap the list under us)
+            live = [
+                (i, r) for i, r in enumerate(self._slots) if r is not None
+            ]
+        if not live:
+            return False
+        width = next(w for w in self._widths if w > live[-1][0])
+        tokens = np.zeros(width, np.int32)
+        temps = np.zeros(width, np.float32)
+        keys = np.zeros((width, 2), np.uint32)
+        for i, row in live:
+            tokens[i] = row.last_token
+            temps[i] = row.temperature
+            if row.keys is not None:
+                keys[i] = row.keys[len(row.out)]
+        fn = self.programs.decode(width)
+        t0 = time.perf_counter()
+        toks, self._k, self._v, self._pos = fn(
+            self.params, self._k, self._v, self._pos,
+            jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
+        )
+        toks = np.asarray(toks)
+        dt = time.perf_counter() - t0
+        telemetry.observe(
+            "serving_batch_occupancy", float(len(live)),
+            bounds=_OCCUPANCY_BOUNDS,
+        )
+        freed = False
+        for i, row in live:
+            telemetry.observe("serving_token_seconds", dt)
+            if self._emit(i, row, int(toks[i])):
+                freed = True
+        return freed
+
+    def _emit(self, slot: int, row: _Row, token: int) -> bool:
+        """Append one generated token to a row; retire the row (freeing
+        its slot) when it has its n_new tokens. Returns True if freed."""
+        row.out.append(token)
+        row.last_token = token
+        self._tokens_out += 1
+        telemetry.incr("serving_tokens_total", model=self.model_id)
+        if len(row.out) < row.n_new:
+            return False
+        with self._lock:
+            self._slots[slot] = None
+            self._live = max(0, self._live - 1)
+        row.pending.finish_row(row.row, row.out)
+        if row.pending.remaining == 0:
+            telemetry.incr(
+                "serving_requests_total", outcome="ok",
+                model=self.model_id,
+            )
+        return True
+
+    def _fail_all(self, err: Exception, reset_cache: bool = True) -> None:
+        cache = None
+        if reset_cache:
+            from pygrid_tpu.models import decode
+
+            # the failed program may have CONSUMED the donated cache
+            # buffers before raising — reallocate so the engine serves
+            # the next request instead of failing forever on deleted
+            # arrays (skipped on close: no one decodes again)
+            cache = decode.init_slot_cache(
+                self.cfg, self.config.max_slots, dtype=self._kv_dtype
+            )
+        with self._lock:
+            rows = [r for r in self._slots if r is not None]
+            rows.extend(self._queue)
+            self._queue.clear()
+            self._slots = [None] * self.config.max_slots
+            self._live = 0
+            if cache is not None:
+                self._k, self._v, self._pos = cache.k, cache.v, cache.pos
+        failed = set()
+        for row in rows:
+            if id(row.pending) not in failed:
+                failed.add(id(row.pending))
+                if not row.pending.future.done():
+                    row.pending.future.set_exception(err)
+        if failed:
+            telemetry.incr(
+                "serving_requests_total", len(failed), outcome="error",
+                model=self.model_id,
+            )
+
+    # ── helpers ─────────────────────────────────────────────────────────
+
+    def _prompt_bucket(self, p_len: int) -> int:
+        for b in self._prompt_buckets:
+            if p_len <= b:
+                return b
+        raise E.PyGridError(
+            f"prompt length {p_len} exceeds model max_len "
+            f"{self.cfg.max_len}"
+        )
+
+    @staticmethod
+    def _row_keys(seed, row, batch, n_new):
+        """Per-row PRNG key schedule matching ``generate()``: split the
+        request key into one key per token. Single-row requests use the
+        request key itself (the same schedule generate() draws from);
+        multi-row prompts fold the row index in, so rows sample
+        independently (distribution-identical to the single-request
+        path, which shares one key across rows)."""
+        import jax
+
+        key = jax.random.PRNGKey(int(seed))
+        if batch > 1:
+            key = jax.random.fold_in(key, row)
+        return np.asarray(jax.random.split(key, n_new))
+
+    def _key_for(self, row: _Row, index: int):
+        import jax.numpy as jnp
+
+        if row.keys is None:
+            return jnp.zeros((2,), jnp.uint32)
+        return jnp.asarray(row.keys[index])

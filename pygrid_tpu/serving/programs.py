@@ -1,0 +1,152 @@
+"""Bucketed jitted programs for the continuous-batching engine.
+
+The recompile pathology this kills: the legacy per-request path jits one
+whole-generation program per distinct ``n_new`` (and jax retraces again
+per prompt length), so a serving node facing organic traffic compiles
+constantly. Here the compiled surface is fixed up front:
+
+- one **prefill** program per prompt-length *bucket* (prompt padded up,
+  true length traced) — admission cost is O(#buckets) compiles ever;
+- one **decode-step** program per slot-width *bucket* — the steady-state
+  loop is O(#width buckets) compiles ever;
+- ``n_new`` never appears in any trace: it is a host-side loop bound.
+
+Temperature and the PRNG key are traced arguments (the greedy/sampled
+choice is a ``jnp.where`` inside the program), so request sampling
+parameters cannot force a retrace either. Every compile increments the
+``serving_compiles_total`` counter — the bench and tests assert the
+count stays flat while request shapes vary within buckets.
+
+Cache buffers are donated (``donate_argnums``): the engine owns the only
+reference, so XLA may update the multi-megabyte k/v arrays in place
+instead of copying them every step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from pygrid_tpu import telemetry
+
+
+def prompt_buckets(max_len: int, smallest: int = 16) -> tuple[int, ...]:
+    """Doubling ladder of prompt pad widths, capped at ``max_len``:
+    16, 32, … max_len. A request's prompt pads up to the first bucket
+    that fits, so at most log2(max_len/16)+1 prefill programs exist."""
+    buckets: list[int] = []
+    b = min(smallest, max_len)
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+def width_buckets(max_slots: int, ladder: Sequence[int]) -> tuple[int, ...]:
+    """Slot-width buckets ≤ ``max_slots`` (always including it), so the
+    decode program runs at the narrowest width covering the live slots."""
+    widths = sorted({w for w in ladder if 0 < w < max_slots} | {max_slots})
+    return tuple(widths)
+
+
+class ProgramSet:
+    """The jitted-program cache for one hosted model: keyed only by
+    bucket sizes, never by request shape. ``compile_count()`` is the
+    observable the no-recompile contract is asserted against."""
+
+    def __init__(
+        self,
+        cfg,
+        compute_dtype: Any | None = None,
+        cache_dtype: Any | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype
+        self.cache_dtype = cache_dtype
+        self._prefill: dict[int, Callable] = {}
+        self._decode: dict[int, Callable] = {}
+        self._compiles = 0
+
+    def compile_count(self) -> int:
+        return self._compiles
+
+    def trace_count(self) -> int:
+        """Actual jit cache entries across every program — catches
+        silent retraces (shape/dtype drift in engine call sites) that
+        the builder-level counter cannot see. Equals
+        :meth:`compile_count` when the no-recompile contract holds;
+        falls back to the builder count where jax lacks the hook."""
+        total = 0
+        for fn in [*self._prefill.values(), *self._decode.values()]:
+            size = getattr(fn, "_cache_size", None)
+            total += size() if callable(size) else 1
+        return total
+
+    def _count(self, kind: str) -> None:
+        self._compiles += 1
+        telemetry.incr("serving_compiles_total", kind=kind)
+
+    @staticmethod
+    def _pick(logits, temp, key):
+        """Greedy/sampled token from one [vocab] logits row; ``temp`` is
+        traced so one program serves every temperature INCLUDING zero
+        (the jnp.where guard — categorical over logits/0 is NaN)."""
+        import jax
+        import jax.numpy as jnp
+
+        safe_t = jnp.where(temp > 0.0, temp, jnp.float32(1.0))
+        sampled = jax.random.categorical(key, logits / safe_t, axis=-1)
+        return jnp.where(
+            temp > 0.0, sampled, jnp.argmax(logits, axis=-1)
+        ).astype(jnp.int32)
+
+    def prefill(self, bucket: int) -> Callable:
+        """``fn(params, k, v, pos, slot, prompt[bucket], length, temp,
+        key) -> (first_token, k, v, pos)`` — admission of one request
+        into one slot, first token picked on-device."""
+        fn = self._prefill.get(bucket)
+        if fn is None:
+            import jax
+
+            from pygrid_tpu.models import decode
+
+            cfg, cd = self.cfg, self.compute_dtype
+
+            def _prefill(params, k, v, pos, slot, prompt, length, temp, key):
+                cache = decode.SlotKVCache(k=k, v=v, pos=pos)
+                logits, cache = decode.prefill_slot(
+                    params, cache, slot, prompt, length, cfg, cd
+                )
+                tok = self._pick(logits, temp, key)
+                return tok, cache.k, cache.v, cache.pos
+
+            fn = jax.jit(_prefill, donate_argnums=(1, 2, 3))
+            self._prefill[bucket] = fn
+            self._count("prefill")
+        return fn
+
+    def decode(self, width: int) -> Callable:
+        """``fn(params, k, v, pos, tokens[w], temps[w], keys[w, 2]) ->
+        (next_tokens[w], k, v, pos)`` — one step for the first ``w``
+        slots, each at its own position, next token picked on-device per
+        slot with that slot's temperature/key."""
+        fn = self._decode.get(width)
+        if fn is None:
+            import jax
+
+            from pygrid_tpu.models import decode
+
+            cfg, cd = self.cfg, self.compute_dtype
+
+            def _decode_step(params, k, v, pos, tokens, temps, keys):
+                cache = decode.SlotKVCache(k=k, v=v, pos=pos)
+                logits, cache = decode.decode_step_slots(
+                    params, cache, tokens, cfg, cd
+                )
+                toks = jax.vmap(self._pick)(logits, temps, keys)
+                return toks, cache.k, cache.v, cache.pos
+
+            fn = jax.jit(_decode_step, donate_argnums=(1, 2, 3))
+            self._decode[width] = fn
+            self._count("decode")
+        return fn

@@ -93,6 +93,15 @@ _FAMILY_HELP: dict[str, str] = {
     "cycles_completed_total": "FL cycles closed, by outcome",
     "heartbeat_rtt_seconds": "network→node heartbeat round trip, by transport",
     "monitor_polls_total": "monitor sweeps per node, by outcome",
+    # continuous-batching generation engine (pygrid_tpu/serving)
+    "serving_requests_total": "generation requests, by model and outcome",
+    "serving_tokens_total": "generated tokens served, by model",
+    "serving_compiles_total": "serving program compiles, by kind",
+    "serving_ttft_seconds": "generation time-to-first-token (enqueue→token)",
+    "serving_token_seconds": "per-token decode latency inside the batch",
+    "serving_prefill_seconds": "per-request slot prefill (admission) time",
+    "serving_queue_wait_seconds": "generation queue wait before a slot",
+    "serving_batch_occupancy": "live slots per decode step",
 }
 
 

@@ -103,6 +103,14 @@ class MaxCycleLimitExceededError(PyGridError):
         self.name = message  # reference carries the process name here
 
 
+class ServerBusyError(PyGridError):
+    """Server busy — generation queue is at its depth limit, retry later.
+
+    The serving engine's backpressure signal (this framework's
+    extension): admission past the bounded queue answers this typed
+    error instead of piling unbounded work onto the node."""
+
+
 # --- execution-plane errors (syft surface rebuilt here) ---------------------
 
 

@@ -1,0 +1,17 @@
+#!/usr/bin/env sh
+# Serving-path smoke: tiny transformer, CPU only, no sockets — catches
+# continuous-batching throughput and recompile regressions in seconds,
+# without a TPU or a live node. The same assertions run under tier-1 via
+# tests/unit/test_bench_serving.py; the full-size capture is bench.py's
+# bench_serving() section (recorded into the round's BENCH file).
+#
+# Usage: scripts/bench_serving.sh [--full]
+set -e
+cd "$(dirname "$0")/.."
+TINY=True
+[ "$1" = "--full" ] && TINY=False
+JAX_PLATFORMS=cpu python -c "
+import json
+from bench import bench_serving
+print(json.dumps(bench_serving(tiny=$TINY), indent=2))
+"

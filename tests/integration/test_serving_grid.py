@@ -1,0 +1,180 @@
+"""Continuous-batching serving over a real grid node.
+
+N concurrent websocket clients issue mixed-length greedy generation
+requests against one hosted bundle and must get EXACTLY the tokens the
+sequential single-request path produces — the end-to-end proof that the
+shared slot cache leaks nothing across concurrently-decoding requests.
+Plus: the async HTTP door, typed backpressure over the wire, and the
+new serving metrics families under the strict Prometheus parser.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from pygrid_tpu.client import DataCentricFLClient
+from pygrid_tpu.models import decode
+from pygrid_tpu.models import transformer as T
+from pygrid_tpu.serde import serialize
+from pygrid_tpu.telemetry import promtext
+
+CFG = T.TransformerConfig(
+    vocab=37, d_model=16, n_heads=2, n_layers=1, d_ff=32, max_len=48
+)
+MODEL_ID = "serving-grid"
+
+
+@pytest.fixture(scope="module")
+def hosted(grid):
+    params = T.init(jax.random.PRNGKey(11), CFG)
+    client = DataCentricFLClient(grid.node_url("dan"))
+    out = client.serve_model(
+        decode.bundle(CFG, params), MODEL_ID, allow_remote_inference=True
+    )
+    assert out.get("success"), out
+    yield params, client
+    client.close()
+
+
+def _cases(n, seed=0):
+    """Mixed prompt lengths and n_new — every (len, n_new) distinct
+    enough that the legacy path would compile per request."""
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            rng.randint(0, CFG.vocab, size=(1, int(rng.randint(1, 9)))),
+            int(rng.randint(1, 10)),
+        )
+        for _ in range(n)
+    ]
+
+
+def test_concurrent_ws_clients_match_sequential_path(grid, hosted):
+    """8 clients, 8 sockets, mixed shapes, all in flight at once: the
+    batched engine's greedy tokens are bit-identical to the sequential
+    single-request ``decode.generate`` for every request."""
+    params, _ = hosted
+    cases = _cases(8, seed=3)
+    results: list = [None] * len(cases)
+    errors: list = []
+
+    def go(i):
+        client = None
+        try:
+            client = DataCentricFLClient(grid.node_url("dan"))
+            prompt, n_new = cases[i]
+            results[i] = client.run_remote_generation(
+                MODEL_ID, prompt, n_new=n_new
+            )
+        except Exception as err:  # noqa: BLE001 — collected for assert
+            errors.append((i, err))
+        finally:
+            if client is not None:
+                client.close()
+
+    threads = [
+        threading.Thread(target=go, args=(i,)) for i in range(len(cases))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for (prompt, n_new), got in zip(cases, results):
+        expect = np.asarray(
+            decode.generate(params, prompt.astype(np.int32), n_new, CFG)
+        )
+        np.testing.assert_array_equal(got, expect)
+
+
+def test_http_route_serves_and_is_typed(grid, hosted):
+    params, client = hosted
+    base = grid.node_url("dan")
+    prompt = np.array([[1, 2, 3]], np.int32)
+    body = {
+        "model_id": MODEL_ID,
+        "data": base64.b64encode(serialize(prompt)).decode(),
+        "n_new": 4,
+    }
+    headers = {"token": client._auth_token}
+    resp = requests.post(
+        base + "/data-centric/run-generation",
+        json=body, headers=headers, timeout=60,
+    )
+    assert resp.status_code == 200, resp.text
+    expect = np.asarray(decode.generate(params, prompt, 4, CFG))
+    np.testing.assert_array_equal(np.asarray(resp.json()["tokens"]), expect)
+    # validation defects are 400 with the same typed message as the WS
+    # door (shared _prepare_generation)
+    bad = dict(body, temperature=True)
+    resp = requests.post(
+        base + "/data-centric/run-generation",
+        json=bad, headers=headers, timeout=30,
+    )
+    assert resp.status_code == 400
+    assert "temperature" in resp.json()["error"]
+    # no session token → 401-family error, not a traceback
+    resp = requests.post(
+        base + "/data-centric/run-generation", json=body, timeout=30
+    )
+    assert resp.status_code in (400, 401, 403)
+
+
+def test_sampled_generation_reproducible_over_wire(grid, hosted):
+    _params, client = hosted
+    a = client.run_remote_generation(
+        MODEL_ID, np.array([[5, 6]]), n_new=6, temperature=0.8, seed=99
+    )
+    b = client.run_remote_generation(
+        MODEL_ID, np.array([[5, 6]]), n_new=6, temperature=0.8, seed=99
+    )
+    np.testing.assert_array_equal(a, b)
+    # the SDK float()-coerces, so drive the raw frame: a string
+    # temperature must bounce typed over the wire (satellite contract)
+    out = client.ws.send_json(
+        "run-generation", model_id=MODEL_ID, n_new=2,
+        data=base64.b64encode(
+            serialize(np.array([[1]], np.int32))
+        ).decode(),
+        temperature="0.9",
+    )
+    assert out.get("success") is False and "temperature" in out["error"]
+
+
+def test_serving_metrics_families_strictly_valid(grid, hosted):
+    """After traffic, the node /metrics exposes the serving families
+    (queue depth, occupancy, TTFT, per-token latency, compiles) and the
+    whole exposition still parses under the strict checker."""
+    base = grid.node_url("dan")
+    families = promtext.parse(
+        requests.get(base + "/metrics", timeout=10).text
+    )
+    for name, kind in (
+        ("pygrid_serving_requests_total", "counter"),
+        ("pygrid_serving_tokens_total", "counter"),
+        ("pygrid_serving_compiles_total", "counter"),
+        ("pygrid_serving_ttft_seconds", "histogram"),
+        ("pygrid_serving_token_seconds", "histogram"),
+        ("pygrid_serving_batch_occupancy", "histogram"),
+        ("pygrid_serving_queue_wait_seconds", "histogram"),
+        ("pygrid_serving_queue_depth", "gauge"),
+        ("pygrid_serving_live_slots", "gauge"),
+        ("pygrid_serving_max_slots", "gauge"),
+    ):
+        assert name in families, f"/metrics missing {name}"
+        assert families[name].type == kind, name
+
+    stats = requests.get(base + "/telemetry/serving", timeout=10).json()
+    (engine,) = [
+        e for e in stats["engines"] if e["model_id"] == MODEL_ID
+    ]
+    assert engine["tokens_total"] > 0
+    assert engine["requests_total"] >= 10
+    assert engine["compiles_total"] > 0

@@ -420,8 +420,18 @@ def test_hostile_generation_payloads_bounce_typed(ctx):
         dict(data=good_prompt, n_new="abc"),
         dict(data=good_prompt, n_new=10**9),          # > max_len
         dict(data=good_prompt, n_new=2, temperature="hot"),
+        # JSON true float()-coerces to 1.0 — a "temperature" nobody set
+        # silently sampling; numeric strings coerce too. The wire
+        # contract is a JSON number: every non-number bounces typed.
+        dict(data=good_prompt, n_new=2, temperature=True),
+        dict(data=good_prompt, n_new=2, temperature=False),
+        dict(data=good_prompt, n_new=2, temperature="0.5"),
+        dict(data=good_prompt, n_new=2, temperature=[0.5]),
+        dict(data=good_prompt, n_new=2, temperature=None),
         dict(data=good_prompt, n_new=2, temperature=-1.0),
         dict(data=good_prompt, n_new=2, temperature=float("nan")),
+        dict(data=good_prompt, n_new=True),            # bool n_new
+        dict(data=good_prompt, n_new=2, temperature=0.5, seed=True),
         # Infinity passes a bare >= 0 check but collapses logits/inf to
         # all-zero — uniform-random tokens silently served (ADVICE #2)
         dict(data=good_prompt, n_new=2, temperature=float("inf")),

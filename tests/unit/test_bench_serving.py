@@ -1,0 +1,33 @@
+"""CI gate for the continuous-batching serving path
+(scripts/bench_serving.sh's twin): at 8 concurrent mixed-shape requests
+the engine must beat the per-request baseline by the tentpole margin at
+equal (bit-identical, asserted inside the bench) outputs, with ZERO
+recompiles while n_new and prompt length vary within one bucket — vs.
+one compiled program per distinct n_new on the legacy path. Regressions
+here fail tier-1 rather than only showing up in the next BENCH capture."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from bench import bench_serving  # noqa: E402
+
+
+def test_serving_bench_smoke_throughput_and_compiles():
+    out = bench_serving(tiny=True)
+    # ≥4× aggregate token throughput against the per-request path at
+    # the same mixed-n_new traffic (whose per-distinct-n_new compiles
+    # are the recurring cost the engine exists to remove; in practice
+    # the margin is orders of magnitude)
+    assert out["serving_throughput_ratio"] >= 4.0, out
+    # the no-recompile contract under shape variety
+    assert out["serving_engine_recompiles_under_traffic"] == 0, out
+    # the legacy path really did compile one program per distinct n_new
+    assert out["serving_baseline_programs_compiled"] == 8, out
+    # the engine's whole compiled surface is a handful of bucketed
+    # programs, not O(traffic variety)
+    assert out["serving_engine_compiled_programs"] <= 8, out
+    assert out["serving_engine_tokens_per_sec"] > 0

@@ -178,10 +178,20 @@ def test_run_generation_validates_seed_and_temperature(setup):
     for bad in (
         dict(temperature=float("inf")),
         dict(temperature=float("-inf")),
+        # JSON true/numeric strings float()-coerce (true → 1.0 silently
+        # samples) — the contract is a JSON number, all else bounces
+        dict(temperature=True),
+        dict(temperature="0.5"),
         dict(temperature=0.5, seed=2**63),
         dict(temperature=0.5, seed=10**30),
         dict(temperature=0.5, seed=-(2**64)),
         dict(temperature=0.5, seed=-1),
+        dict(temperature=0.5, seed=True),
+        dict(temperature=0.5, seed="5"),
+        dict(temperature=0.5, seed=1.5),
+        dict(n_new=True),
+        dict(n_new="8"),
+        dict(n_new=2.5),
     ):
         out = gen(**bad)
         assert out.get("success") is False and "error" in out, (bad, out)

@@ -684,3 +684,25 @@ def test_fedbuff_migration_marks_preexisting_rows_flushed(fresh_db):
     still_open = ctl.cycle_manager._worker_cycles.first(worker_id="open-w")
     assert not still_open.flushed
     assert ctl.cycle_manager._async_buffered_count(0) == 0
+
+
+def test_empty_diff_accumulator_mean_is_typed():
+    """A cycle can flush with zero accepted reports (deadline fires,
+    every diff bounced validation): ``_DiffAccumulator.mean()`` on the
+    empty accumulator used to raise a raw TypeError (iterating
+    ``sums=None``) — it must surface the real condition as a typed
+    PyGridError the protocol boundary can frame."""
+    from pygrid_tpu.federated.cycle_manager import _DiffAccumulator
+
+    acc = _DiffAccumulator()
+    with pytest.raises(E.PyGridError, match="zero accepted reports"):
+        acc.mean()
+    # zero total weight (all contributions weighted to nothing) is the
+    # same condition via the ZeroDivisionError door
+    acc.add([np.zeros(3, np.float32)], weight=0.0)
+    with pytest.raises(E.PyGridError, match="zero accepted reports"):
+        acc.mean()
+    # a real report still averages
+    acc.add([np.ones(3, np.float32)], weight=2.0)
+    (mean,) = acc.mean()
+    np.testing.assert_allclose(mean, np.ones(3, np.float32))
