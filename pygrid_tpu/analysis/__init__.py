@@ -1,0 +1,63 @@
+"""gridlint — the repo-native static-analysis suite.
+
+Production stacks gate their invariants mechanically, not by reviewer
+vigilance. This package is an AST-based checker framework purpose-built
+for the failure modes THIS codebase has actually shipped (and caught by
+luck): host side-effects reachable from jitted programs, lock/thread
+hazards across the engine worker threads + telemetry bus + cycle
+manager, event-loop-blocking calls inside async aiohttp handlers, and
+contract drift between the wire/telemetry surface and its specs
+(docs/WIRE.md tag bytes, docs/OBSERVABILITY.md metric families).
+
+Run it:
+
+    python -m pygrid_tpu.analysis pygrid_tpu/
+    scripts/gridlint.sh
+
+Checkers (see docs/ANALYSIS.md for the full rule catalogue):
+
+- **GL1 trace-safety** (GL101/GL102/GL103) — host side-effects inside
+  functions passed to ``jax.jit``/``pjit``; ``.item()`` host syncs;
+  jit-per-call recompile hazards.
+- **GL2 thread/lock discipline** (GL201/GL202/GL203) — lock-acquisition
+  -order cycles, mutation of lock-protected ``self._`` state outside
+  any ``with self._lock``, nested acquisition of an aliased
+  non-reentrant lock.
+- **GL3 async hygiene** (GL301/GL302/GL303) — blocking calls
+  (``time.sleep``, sync sockets/requests, ``Future.result()``,
+  unbounded ``queue.get()``, megabyte serde) on the event loop inside
+  ``async def`` handlers.
+- **GL4 contract drift** (GL401/GL402/GL403/GL404) — bus metric
+  families vs docs/OBSERVABILITY.md and the exporter HELP registry;
+  wire tag bytes / subprotocol strings vs docs/WIRE.md (and their
+  uniqueness); bare ``ValueError``/``KeyError``/``TypeError`` raises in
+  WS/HTTP handler modules that must raise typed ``PyGridError``s.
+
+Per-line suppression: append ``# gridlint: disable=GL202`` (or a
+comma-separated list, or ``all``) to any line of the offending
+statement — suppressions are reported, never silent. Pre-existing
+findings live in the committed baseline (``analysis/baseline.json``)
+keyed ``(path, code) -> count`` with a justification note; a baseline
+entry larger than reality is reported as *stale* so the allowance
+shrinks as code heals.
+"""
+
+from __future__ import annotations
+
+from pygrid_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    RunResult,
+    default_baseline_path,
+    run_checks,
+)
+from pygrid_tpu.analysis.checkers import ALL_CHECKERS  # noqa: F401
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Baseline",
+    "Finding",
+    "RunResult",
+    "default_baseline_path",
+    "run_checks",
+]

@@ -1,0 +1,7 @@
+"""``python -m pygrid_tpu.analysis`` — the gridlint CLI."""
+
+import sys
+
+from pygrid_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,26 @@
+"""The gridlint checker registry.
+
+Checkers are classes; every run instantiates fresh ones (GL2/GL4 carry
+cross-file state between ``check_module`` and ``finalize``)."""
+
+from __future__ import annotations
+
+from pygrid_tpu.analysis.checkers.gl1_trace import TraceSafetyChecker
+from pygrid_tpu.analysis.checkers.gl2_locks import LockDisciplineChecker
+from pygrid_tpu.analysis.checkers.gl3_async import AsyncHygieneChecker
+from pygrid_tpu.analysis.checkers.gl4_contracts import ContractDriftChecker
+
+ALL_CHECKERS = (
+    TraceSafetyChecker,
+    LockDisciplineChecker,
+    AsyncHygieneChecker,
+    ContractDriftChecker,
+)
+
+__all__ = [
+    "ALL_CHECKERS",
+    "AsyncHygieneChecker",
+    "ContractDriftChecker",
+    "LockDisciplineChecker",
+    "TraceSafetyChecker",
+]

@@ -1,0 +1,292 @@
+"""GL1 — JAX trace-safety.
+
+The failure mode: host side-effects inside code that runs under
+``jax.jit``/``pjit`` fire once per TRACE, not once per call — telemetry
+counters silently stop counting, locks are acquired at trace time and
+never again, ``time.perf_counter()`` measures compilation instead of
+execution, and ``.item()``/``int()`` forces a device sync (or a
+ConcretizationError) in the middle of a compiled program. The serving
+engine's no-recompile contract (``serving/programs.py``) also dies by a
+thousand ``jax.jit(...)(x)`` cuts: a jit built per call retraces per
+call.
+
+Detection is module-local and deliberately conservative: a function is
+*jitted* when it is decorated with ``jit``/``pjit`` (bare, dotted, or
+via ``partial(jax.jit, ...)``) or its name/lambda is passed as the
+first argument to a ``jit``/``pjit`` call anywhere in the module.
+Reachability then closes over module-level functions and same-class
+``self.``/``cls.`` methods called from a jitted body — cross-module
+calls are out of scope (see ROADMAP open items).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from pygrid_tpu.analysis.core import Checker, Finding, ModuleContext
+
+#: call spellings that enter a trace
+_JIT_NAMES = {"jit", "pjit"}
+
+#: ``module.attr`` calls that are host side-effects (GL101)
+_SIDE_EFFECT_ATTRS = {
+    ("telemetry", "record"), ("telemetry", "incr"), ("telemetry", "observe"),
+    ("time", "time"), ("time", "sleep"), ("time", "perf_counter"),
+    ("time", "monotonic"), ("time", "process_time"),
+    ("os", "urandom"), ("random", "random"), ("random", "randint"),
+}
+#: bare-name calls that are host side-effects when invoked in a trace
+_SIDE_EFFECT_NAMES = {"print", "record", "incr", "observe"}
+#: logger-ish receivers: ``logger.info(...)`` etc.
+_LOGGER_RECEIVERS = {"logger", "logging", "log"}
+_LOGGER_METHODS = {
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` → "a.b.c" for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """Does this expression name ``jit``/``pjit`` (bare or dotted)?"""
+    dotted = _dotted(node)
+    if dotted is None:
+        return False
+    return dotted.split(".")[-1] in _JIT_NAMES
+
+
+def _jit_call_target(call: ast.Call) -> ast.AST | None:
+    """The function being jitted, if ``call`` is ``jit(fn, ...)``."""
+    if _is_jit_callable(call.func) and call.args:
+        return call.args[0]
+    return None
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """Module-level defs, class methods, and which are jitted."""
+
+    def __init__(self) -> None:
+        # qualified name -> def node. Module funcs: "f"; methods: "C.f".
+        self.defs: dict[str, ast.AST] = {}
+        self.jitted: list[tuple[ast.AST, str]] = []  # (fn node, how)
+        self._class_stack: list[str] = []
+
+    def _qual(self, name: str) -> str:
+        return (
+            f"{self._class_stack[-1]}.{name}" if self._class_stack else name
+        )
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_def(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self.defs[self._qual(node.name)] = node
+        for deco in node.decorator_list:
+            target = deco
+            if isinstance(deco, ast.Call):
+                # @partial(jax.jit, ...) / @jax.jit(...)
+                if _is_jit_callable(deco.func):
+                    self.jitted.append((node, "decorator"))
+                    break
+                fn_dotted = _dotted(deco.func)
+                if fn_dotted and fn_dotted.split(".")[-1] == "partial":
+                    if any(_is_jit_callable(a) for a in deco.args[:1]):
+                        self.jitted.append((node, "partial decorator"))
+                        break
+                continue
+            if _is_jit_callable(target):
+                self.jitted.append((node, "decorator"))
+                break
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Call(self, node: ast.Call) -> None:
+        target = _jit_call_target(node)
+        if target is not None:
+            if isinstance(target, ast.Lambda):
+                self.jitted.append((target, "jit(lambda)"))
+            else:
+                dotted = _dotted(target)
+                if dotted is not None:
+                    self.jitted.append((dotted, "jit(name)"))  # resolve later
+        self.generic_visit(node)
+
+
+class _TraceBodyScan(ast.NodeVisitor):
+    """Walk one jitted body collecting side-effects and outgoing calls."""
+
+    def __init__(self) -> None:
+        self.effects: list[tuple[ast.AST, str, str]] = []  # node, code, msg
+        self.calls: set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id in _SIDE_EFFECT_NAMES:
+                self.effects.append(
+                    (node, "GL101", f"host side-effect call '{fn.id}()'")
+                )
+            self.calls.add(fn.id)
+        elif isinstance(fn, ast.Attribute):
+            dotted = _dotted(fn)
+            recv = dotted.split(".")[0] if dotted else ""
+            if (recv, fn.attr) in _SIDE_EFFECT_ATTRS:
+                self.effects.append(
+                    (node, "GL101", f"host side-effect call '{recv}.{fn.attr}()'")
+                )
+            elif recv in _LOGGER_RECEIVERS and fn.attr in _LOGGER_METHODS:
+                self.effects.append(
+                    (node, "GL101", f"logging call '{recv}.{fn.attr}()'")
+                )
+            elif fn.attr == "acquire":
+                self.effects.append(
+                    (node, "GL101", f"lock acquisition '{dotted}()'")
+                )
+            elif fn.attr == "item" and not node.args:
+                self.effects.append(
+                    (
+                        node,
+                        "GL102",
+                        "'.item()' forces a host sync inside a traced "
+                        "function",
+                    )
+                )
+            if dotted:
+                self.calls.add(dotted)
+                if dotted.startswith(("self.", "cls.")):
+                    self.calls.add(dotted.split(".", 1)[1])
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            dotted = _dotted(item.context_expr)
+            if dotted and "lock" in dotted.rsplit(".", 1)[-1].lower():
+                self.effects.append(
+                    (
+                        item.context_expr,
+                        "GL101",
+                        f"lock acquisition 'with {dotted}' inside a traced "
+                        "function",
+                    )
+                )
+        self.generic_visit(node)
+
+
+class TraceSafetyChecker(Checker):
+    name = "GL1"
+    description = "host side-effects / recompile hazards under jax.jit"
+    codes = {
+        "GL101": "host side-effect reachable inside a jitted function",
+        "GL102": ".item() host sync inside a jitted function",
+        "GL103": "jit-per-call / jit-in-loop recompile hazard",
+    }
+
+    def check_module(self, mod: ModuleContext) -> Iterable[Finding]:
+        index = _FunctionIndex()
+        index.visit(mod.tree)
+
+        # resolve "jit(name)" entries to def nodes where possible
+        roots: list[ast.AST] = []
+        for entry, _how in index.jitted:
+            if isinstance(entry, str):
+                short = entry.split(".")[-1]
+                node = index.defs.get(entry)
+                if node is None:
+                    for name, cand in index.defs.items():
+                        if name.split(".")[-1] == short:
+                            node = cand
+                            break
+                if node is not None:
+                    roots.append(node)
+            else:
+                roots.append(entry)
+
+        findings: list[Finding] = []
+        scans: dict[int, _TraceBodyScan] = {}
+
+        def _scan(fn_node: ast.AST) -> _TraceBodyScan:
+            key = id(fn_node)
+            if key not in scans:
+                scan = _TraceBodyScan()
+                body = getattr(fn_node, "body", [])
+                for stmt in body if isinstance(body, list) else [body]:
+                    scan.visit(stmt)
+                scans[key] = scan
+            return scans[key]
+
+        # reachability closure over module/class-local callees
+        seen: set[int] = set()
+        frontier = list(roots)
+        while frontier:
+            fn_node = frontier.pop()
+            if id(fn_node) in seen:
+                continue
+            seen.add(id(fn_node))
+            scan = _scan(fn_node)
+            for node, code, msg in scan.effects:
+                findings.append(
+                    mod.finding(code, node, f"{msg} (reachable under jax.jit)")
+                )
+            for callee in scan.calls:
+                short = callee.split(".")[-1]
+                for target_name, target in index.defs.items():
+                    if target_name == callee or target_name.split(".")[
+                        -1
+                    ] in (callee, short):
+                        if id(target) not in seen:
+                            frontier.append(target)
+
+        # GL103: jit(...)(...) immediately invoked, or jit built in a loop
+        class _JitUse(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.loops = 0
+                self.out: list[tuple[ast.AST, str]] = []
+
+            def visit_Call(self, node: ast.Call) -> None:
+                if isinstance(node.func, ast.Call) and _is_jit_callable(
+                    node.func.func
+                ):
+                    self.out.append(
+                        (
+                            node,
+                            "jit(...) called immediately — one trace+compile "
+                            "per invocation",
+                        )
+                    )
+                elif _is_jit_callable(node.func) and self.loops:
+                    self.out.append(
+                        (
+                            node,
+                            "jit(...) constructed inside a loop — retraces "
+                            "every iteration",
+                        )
+                    )
+                self.generic_visit(node)
+
+            def _loop(self, node: ast.For | ast.While) -> None:
+                self.loops += 1
+                self.generic_visit(node)
+                self.loops -= 1
+
+            visit_For = _loop
+            visit_While = _loop
+
+        jit_use = _JitUse()
+        jit_use.visit(mod.tree)
+        for node, msg in jit_use.out:
+            findings.append(mod.finding("GL103", node, msg))
+        return findings

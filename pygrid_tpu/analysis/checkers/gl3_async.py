@@ -1,0 +1,186 @@
+"""GL3 — async hygiene for the aiohttp event loop.
+
+The node and network apps are single-event-loop aiohttp servers; one
+blocking call inside an ``async def`` handler stalls every socket the
+process serves (heartbeats included — the network marks nodes offline
+for it). Three grades:
+
+- **GL301** stdlib blocking primitives: ``time.sleep``, sync HTTP
+  (``requests.*``, ``urllib.request.urlopen``), raw socket I/O,
+  ``subprocess.run``/``os.system``.
+- **GL302** concurrency-primitive waits: ``Future.result()``, thread
+  ``.join()``, blocking ``queue.get()`` — each parks the loop thread
+  until another thread produces, which may itself need the loop.
+- **GL303** repo-known heavy calls: the serde hot loop
+  (``serialize``/``deserialize``/``to_hex``/``from_hex``), base64 of
+  model-scale blobs, frame compression, and the sync WS-handler bridges
+  (``ws_report`` and friends decode megabyte diffs) — all measured in
+  milliseconds-to-seconds at checkpoint scale (docs/WIRE.md §1,
+  ``bench.bench_wire``), i.e. event-loop poison. Ship them to an
+  executor: ``await loop.run_in_executor(None, fn, ...)``.
+
+Only code that executes ON the loop is flagged: nested sync ``def``s
+and ``lambda``s inside an async handler are exempt (they are what you
+hand to ``run_in_executor``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from pygrid_tpu.analysis.core import Checker, Finding, ModuleContext
+from pygrid_tpu.analysis.checkers.gl1_trace import _dotted
+
+#: (receiver, method) → GL301
+_BLOCKING_ATTRS = {
+    ("time", "sleep"): "time.sleep() parks the event loop",
+    ("requests", "get"): "sync HTTP on the event loop",
+    ("requests", "post"): "sync HTTP on the event loop",
+    ("requests", "put"): "sync HTTP on the event loop",
+    ("requests", "delete"): "sync HTTP on the event loop",
+    ("requests", "request"): "sync HTTP on the event loop",
+    ("requests", "head"): "sync HTTP on the event loop",
+    ("urllib.request", "urlopen"): "sync HTTP on the event loop",
+    ("socket", "create_connection"): "sync socket I/O on the event loop",
+    ("subprocess", "run"): "subprocess wait on the event loop",
+    ("subprocess", "call"): "subprocess wait on the event loop",
+    ("subprocess", "check_call"): "subprocess wait on the event loop",
+    ("subprocess", "check_output"): "subprocess wait on the event loop",
+    ("os", "system"): "subprocess wait on the event loop",
+}
+
+#: socket-object methods — flagged on any receiver named like a socket
+_SOCKET_METHODS = {"recv", "recv_into", "accept", "connect", "sendall"}
+
+#: queue-ish receiver names for the GL302 ``.get()`` rule
+_QUEUEISH = ("queue", "_q",)
+
+#: repo-known blocking callables (GL303): bare-name or attr spellings
+_REPO_BLOCKING = {
+    "serialize": "serde serialize() of model-scale payloads",
+    "deserialize": "serde deserialize() of model-scale payloads",
+    "to_hex": "serde hex encode of model-scale payloads",
+    "from_hex": "serde hex decode of model-scale payloads",
+    "b64decode": "base64 decode of model-scale payloads",
+    "b64encode": "base64 encode of model-scale payloads",
+    "b64_decode": "native base64 decode of model-scale payloads",
+    "encode_frame": "wire-v2 frame compression",
+    "decode_frame": "wire-v2 frame decompression",
+    "decode_frame_traced": "wire-v2 frame decompression",
+    # sync WS event handlers bridged into async HTTP routes: these
+    # decode/aggregate megabyte FL payloads synchronously
+    "ws_report": "sync WS report handler (megabyte diff decode)",
+    "ws_cycle_request": "sync WS cycle-request handler (DB + assign)",
+    "ws_authenticate": "sync WS authenticate handler (DB + JWT verify)",
+}
+
+
+class _AsyncBodyScan(ast.NodeVisitor):
+    """Walk one async function body WITHOUT descending into nested sync
+    defs/lambdas (those run wherever the caller ships them)."""
+
+    def __init__(self) -> None:
+        self.hits: list[tuple[ast.AST, str, str]] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return  # sync helper: runs off-loop (executor fodder)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return  # nested async def has its own scan
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            reason = _REPO_BLOCKING.get(fn.id)
+            if reason is not None:
+                self.hits.append(
+                    (node, "GL303", f"'{fn.id}()' — {reason}")
+                )
+        elif isinstance(fn, ast.Attribute):
+            dotted = _dotted(fn) or f"?.{fn.attr}"
+            recv = dotted.rsplit(".", 1)[0]
+            hit = _BLOCKING_ATTRS.get((recv, fn.attr))
+            if hit is not None:
+                self.hits.append((node, "GL301", f"'{dotted}()' — {hit}"))
+            elif fn.attr in _SOCKET_METHODS and "sock" in recv.lower():
+                self.hits.append(
+                    (
+                        node,
+                        "GL301",
+                        f"'{dotted}()' — sync socket I/O on the event loop",
+                    )
+                )
+            elif fn.attr == "result":
+                self.hits.append(
+                    (
+                        node,
+                        "GL302",
+                        f"'{dotted}()' — Future.result() parks the loop; "
+                        "await asyncio.wrap_future(...) instead",
+                    )
+                )
+            elif fn.attr == "join" and "thread" in recv.lower():
+                self.hits.append(
+                    (
+                        node,
+                        "GL302",
+                        f"'{dotted}()' — thread join parks the loop",
+                    )
+                )
+            elif (
+                fn.attr == "get"
+                and any(q in recv.lower().split(".")[-1] for q in _QUEUEISH)
+                # any argument bounds or unblocks it: get(timeout),
+                # get(block=False), get_nowait — only the bare call waits
+                # forever
+                and not node.args
+                and not node.keywords
+            ):
+                self.hits.append(
+                    (
+                        node,
+                        "GL302",
+                        f"'{dotted}()' — unbounded queue.get() parks the "
+                        "loop",
+                    )
+                )
+            else:
+                reason = _REPO_BLOCKING.get(fn.attr)
+                if reason is not None:
+                    self.hits.append(
+                        (node, "GL303", f"'{dotted}()' — {reason}")
+                    )
+        self.generic_visit(node)
+
+
+class AsyncHygieneChecker(Checker):
+    name = "GL3"
+    description = "blocking calls inside async def handlers"
+    codes = {
+        "GL301": "stdlib blocking call on the event loop",
+        "GL302": "Future/thread/queue wait on the event loop",
+        "GL303": "repo-known heavy call (serde/base64/compression) on the "
+        "event loop",
+    }
+
+    def check_module(self, mod: ModuleContext) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            scan = _AsyncBodyScan()
+            for stmt in node.body:
+                scan.visit(stmt)
+            for site, code, msg in scan.hits:
+                findings.append(
+                    mod.finding(
+                        code,
+                        site,
+                        f"async def '{node.name}': {msg}",
+                    )
+                )
+        return findings

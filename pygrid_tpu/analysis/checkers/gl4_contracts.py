@@ -1,0 +1,250 @@
+"""GL4 — wire/telemetry contract drift.
+
+The wire format and the metric surface are *published contracts*
+(docs/WIRE.md is written for foreign-client implementors;
+docs/OBSERVABILITY.md for operators wiring dashboards). Code drifting
+from them is a silent break for consumers this repo never tests:
+
+- **GL401** every metric family passed to the telemetry bus
+  (``telemetry.incr``/``observe`` first-arg string literal) must appear
+  in ``docs/OBSERVABILITY.md`` (bare or ``pygrid_``-prefixed — the
+  exporter prefixes on render).
+- **GL402** the same family must be registered in the exporter HELP
+  registry (the ``_FAMILY_HELP`` dict in ``telemetry/bus.py``) so
+  ``/metrics`` ships a real description, not a fallback.
+- **GL403** wire constants: ``EXT_*`` codes, ``FRAME_*`` tags and
+  ``WS_SUBPROTOCOL*`` strings must be unique within their group, every
+  tag byte documented in ``docs/WIRE.md`` (as ``0xNN``), every
+  subprotocol string quoted there verbatim.
+- **GL404** WS event / HTTP route handler modules must raise typed
+  ``PyGridError`` subclasses for validation — a bare
+  ``ValueError``/``KeyError``/``TypeError`` escapes the protocol
+  boundary as an untyped 500/cryptic string.
+
+Docs are resolved against the run root (``docs/OBSERVABILITY.md``,
+``docs/WIRE.md``); with no docs present the doc-membership rules stay
+quiet (fixture trees opt in by shipping a ``docs/`` dir).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from pygrid_tpu.analysis.core import Checker, Finding, ModuleContext
+
+#: modules whose functions serve the WS/HTTP protocol boundary (GL404).
+#: fnmatch-style, matched against repo-relative paths.
+_HANDLER_MODULE_PATTERNS = (
+    "*/node/events.py",
+    "*/node/routes.py",
+    "*/node/ws.py",
+    "*/network/routes.py",
+    "*/network/ws.py",
+    "*/users/events.py",
+)
+
+_BARE_ERRORS = {"ValueError", "KeyError", "TypeError"}
+
+
+def _is_bus_metric_call(node: ast.Call) -> str | None:
+    """The family-name literal if ``node`` is ``telemetry.incr/observe``
+    (or a bus-bound ``incr``/``observe``/``BUS.incr``...)."""
+    fn = node.func
+    attr = None
+    if isinstance(fn, ast.Attribute):
+        attr = fn.attr
+        recv_ok = (
+            isinstance(fn.value, ast.Name)
+            and fn.value.id in ("telemetry", "BUS", "bus")
+        )
+        if not recv_ok:
+            return None
+    elif isinstance(fn, ast.Name) and fn.id in ("incr", "observe"):
+        attr = fn.id
+    if attr not in ("incr", "observe"):
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+        node.args[0].value, str
+    ):
+        return node.args[0].value
+    return None
+
+
+class ContractDriftChecker(Checker):
+    name = "GL4"
+    description = "wire/telemetry surface vs docs + typed-error contract"
+    codes = {
+        "GL401": "bus metric family missing from docs/OBSERVABILITY.md",
+        "GL402": "bus metric family missing from the _FAMILY_HELP registry",
+        "GL403": "wire constant duplicated or missing from docs/WIRE.md",
+        "GL404": "bare ValueError/KeyError/TypeError raised in a handler "
+        "module",
+    }
+
+    def __init__(self) -> None:
+        # family -> EVERY call site (mod, node): findings anchor per
+        # site, so suppressing one site cannot swallow another file's
+        # use of the same undocumented family
+        self._metric_sites: dict[
+            str, list[tuple[ModuleContext, ast.Call]]
+        ] = {}
+        self._family_help: set[str] | None = None
+        # group name -> [(const name, value, mod, node)]
+        self._wire_consts: dict[str, list] = {}
+        self._wire_protocols: list[tuple[str, str, ModuleContext, ast.AST]] = []
+
+    # ── per-module collection ───────────────────────────────────────────
+
+    def check_module(self, mod: ModuleContext) -> Iterable[Finding]:
+        import fnmatch
+
+        findings: list[Finding] = []
+        is_bus_module = mod.rel_path.endswith("telemetry/bus.py")
+        is_wire_module = mod.rel_path.endswith("serde/wire.py")
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                family = _is_bus_metric_call(node)
+                if family is not None:
+                    self._metric_sites.setdefault(family, []).append(
+                        (mod, node)
+                    )
+            if is_bus_module and isinstance(node, ast.Assign):
+                targets = [
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                ]
+                if "_FAMILY_HELP" in targets and isinstance(
+                    node.value, ast.Dict
+                ):
+                    self._family_help = {
+                        k.value
+                        for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+            if is_wire_module and isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if not isinstance(node.value, ast.Constant):
+                        continue
+                    value = node.value.value
+                    if t.id.startswith(("EXT_", "FRAME_")) and isinstance(
+                        value, int
+                    ):
+                        group = t.id.split("_", 1)[0]
+                        self._wire_consts.setdefault(group, []).append(
+                            (t.id, value, mod, node)
+                        )
+                    elif t.id.startswith("WS_SUBPROTOCOL") and isinstance(
+                        value, str
+                    ):
+                        self._wire_protocols.append((t.id, value, mod, node))
+
+        # GL404 — handler modules must raise typed errors
+        if any(
+            fnmatch.fnmatch(mod.rel_path, pat)
+            for pat in _HANDLER_MODULE_PATTERNS
+        ):
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = node.exc
+                name = None
+                if isinstance(exc, ast.Call) and isinstance(
+                    exc.func, ast.Name
+                ):
+                    name = exc.func.id
+                elif isinstance(exc, ast.Name):
+                    name = exc.id
+                if name in _BARE_ERRORS:
+                    findings.append(
+                        mod.finding(
+                            "GL404",
+                            node,
+                            f"handler module raises bare '{name}' — raise a "
+                            "typed PyGridError subclass so the protocol "
+                            "boundary answers a typed error",
+                        )
+                    )
+        return findings
+
+    # ── cross-file rules ────────────────────────────────────────────────
+
+    @staticmethod
+    def _read_doc(run, name: str) -> str | None:
+        path = os.path.join(run.root, "docs", name)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    def finalize(self, run) -> Iterable[Finding]:
+        findings: list[Finding] = []
+
+        obs_doc = self._read_doc(run, "OBSERVABILITY.md")
+        for family in sorted(self._metric_sites):
+            for mod, node in self._metric_sites[family]:
+                if obs_doc is not None and (
+                    family not in obs_doc
+                    and f"pygrid_{family}" not in obs_doc
+                ):
+                    findings.append(
+                        mod.finding(
+                            "GL401",
+                            node,
+                            f"metric family '{family}' is not documented "
+                            "in docs/OBSERVABILITY.md",
+                        )
+                    )
+                if (
+                    self._family_help is not None
+                    and family not in self._family_help
+                ):
+                    findings.append(
+                        mod.finding(
+                            "GL402",
+                            node,
+                            f"metric family '{family}' has no entry in "
+                            "telemetry.bus._FAMILY_HELP — /metrics ships "
+                            "a fallback HELP line",
+                        )
+                    )
+
+        wire_doc = self._read_doc(run, "WIRE.md")
+        for group, consts in sorted(self._wire_consts.items()):
+            seen: dict[int, str] = {}
+            for name, value, mod, node in consts:
+                if value in seen:
+                    findings.append(
+                        mod.finding(
+                            "GL403",
+                            node,
+                            f"wire constant {name} duplicates the value of "
+                            f"{seen[value]} ({value:#x})",
+                        )
+                    )
+                else:
+                    seen[value] = name
+                if wire_doc is not None and f"{value:#04x}" not in wire_doc:
+                    findings.append(
+                        mod.finding(
+                            "GL403",
+                            node,
+                            f"wire constant {name} ({value:#04x}) is not "
+                            "documented in docs/WIRE.md",
+                        )
+                    )
+        for name, value, mod, node in self._wire_protocols:
+            if wire_doc is not None and value not in wire_doc:
+                findings.append(
+                    mod.finding(
+                        "GL403",
+                        node,
+                        f"subprotocol {name} ({value!r}) is not documented "
+                        "in docs/WIRE.md",
+                    )
+                )
+        return findings

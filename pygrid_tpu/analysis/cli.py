@@ -1,0 +1,158 @@
+"""The gridlint command line: ``python -m pygrid_tpu.analysis [paths]``.
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when
+non-baselined findings (or parse errors) exist, 2 on usage errors.
+Stale-baseline entries are reported but non-fatal unless
+``--strict-baseline`` (the tier-1 test runs strict so allowances
+ratchet down as code heals).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from pygrid_tpu.analysis.checkers import ALL_CHECKERS
+from pygrid_tpu.analysis.core import default_baseline_path, run_checks
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m pygrid_tpu.analysis",
+        description="gridlint — repo-native static analysis "
+        "(trace-safety, lock discipline, async hygiene, contract drift)",
+    )
+    parser.add_argument(
+        "targets", nargs="*", default=["pygrid_tpu"],
+        help="files or directories to check (default: pygrid_tpu)",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated checker families to run (e.g. GL1,GL3)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON path (default: the committed "
+        "pygrid_tpu/analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, committed allowances ignored",
+    )
+    parser.add_argument(
+        "--strict-baseline", action="store_true",
+        help="stale baseline entries fail the run",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    parser.add_argument(
+        "--list-checkers", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="suppress the summary (findings only)",
+    )
+    return parser
+
+
+def _list_checkers() -> str:
+    lines = []
+    for cls in ALL_CHECKERS:
+        lines.append(f"{cls.name}  {cls.description}")
+        for code, what in sorted(cls.codes.items()):
+            lines.append(f"  {code}  {what}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_checkers:
+        print(_list_checkers())
+        return 0
+
+    import os
+
+    missing = [t for t in args.targets if not os.path.exists(t)]
+    if missing:
+        # a typo'd path silently checking nothing would make the lint
+        # gate pass vacuously — that is a usage error, not a clean run
+        print(
+            f"no such target(s): {', '.join(missing)}", file=sys.stderr
+        )
+        return 2
+
+    checkers = [cls() for cls in ALL_CHECKERS]
+    if args.select:
+        wanted = {s.strip().upper() for s in args.select.split(",")}
+        unknown = wanted - {cls.name for cls in ALL_CHECKERS}
+        if unknown:
+            print(
+                f"unknown checker(s): {', '.join(sorted(unknown))}",
+                file=sys.stderr,
+            )
+            return 2
+        checkers = [c for c in checkers if c.name in wanted]
+
+    baseline_path: str | None
+    if args.no_baseline:
+        baseline_path = ""
+    elif args.baseline is not None:
+        baseline_path = args.baseline
+    else:
+        baseline_path = str(default_baseline_path())
+
+    t0 = time.perf_counter()
+    result = run_checks(
+        args.targets, checkers=checkers, baseline_path=baseline_path
+    )
+    elapsed = time.perf_counter() - t0
+
+    failed = bool(result.failures or result.parse_errors) or (
+        args.strict_baseline and bool(result.stale_baseline)
+    )
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "ok": not failed,
+                    "files_checked": result.files_checked,
+                    "elapsed_s": round(elapsed, 3),
+                    "failures": [f.__dict__ for f in result.failures],
+                    "baselined": [f.__dict__ for f in result.baselined],
+                    "suppressed": [f.__dict__ for f in result.suppressed],
+                    "stale_baseline": result.stale_baseline,
+                    "parse_errors": result.parse_errors,
+                },
+                indent=2,
+            )
+        )
+        return 1 if failed else 0
+
+    for err in result.parse_errors:
+        print(f"PARSE ERROR {err}")
+    for f in result.failures:
+        print(f.render())
+    for f in result.suppressed:
+        print(f"suppressed: {f.render()}")
+    for note in result.stale_baseline:
+        print(f"stale baseline: {note}")
+    if not args.quiet:
+        print(
+            f"gridlint: {result.files_checked} files, "
+            f"{len(result.failures)} finding(s), "
+            f"{len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed, "
+            f"{len(result.stale_baseline)} stale baseline entr"
+            f"{'y' if len(result.stale_baseline) == 1 else 'ies'} "
+            f"in {elapsed:.2f}s"
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -95,9 +95,12 @@ class GridWSClient:
         return self
 
     def close(self) -> None:
-        if self._ws is not None:
-            self._ws.close()
-            self._ws = None
+        # under the lock: close() racing an in-flight _request must not
+        # null the socket mid-round-trip (gridlint GL202)
+        with self._lock:
+            if self._ws is not None:
+                self._ws.close()
+                self._ws = None
 
     def __enter__(self) -> "GridWSClient":
         return self.connect()
@@ -166,7 +169,8 @@ class GridWSClient:
                 return response
 
     def _drop_connection(self) -> None:
-        """A transport error mid-round-trip leaves the stream position
+        """Under the lock (every caller is a locked round-trip path): a
+        transport error mid-round-trip leaves the stream position
         unknown (e.g. a recv timeout after part of a frame was consumed)
         — never reuse the socket; the next call reconnects."""
         if self._ws is not None:

@@ -172,6 +172,8 @@ class SecAggService:
         return cycle
 
     def _state(self, cycle, cfg: dict) -> _CycleState:
+        """Under the lock: every caller resolves cycle state inside
+        ``with self._lock`` (get-or-create must be atomic per cycle)."""
         st = self._cycles.get(cycle.id)
         if st is None:
             roster_size = int(

@@ -38,6 +38,9 @@ class _DaemonPool:
             self._spawn()
 
     def _spawn(self) -> None:
+        """Under the lock: ``submit`` grows the pool while holding
+        ``_grow_lock``; the ``__init__`` calls are pre-publication
+        (single-threaded by definition)."""
         self._n += 1
         threading.Thread(
             target=self._loop, name=f"task-{self._n}", daemon=True

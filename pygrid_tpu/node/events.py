@@ -473,6 +473,13 @@ def _authenticated(conn: Connection) -> None:
 def host_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     _authenticated(conn)
     try:
+        # missing fields bounce typed, not as a cryptic KeyError string
+        # from the dispatch boundary (gridlint GL4 satellite audit)
+        for field_name in (MSG_FIELD.MODEL, MSG_FIELD.MODEL_ID):
+            if field_name not in message:
+                raise E.MissingRequestKeyError(
+                    f"missing required field '{field_name}'"
+                )
         serialized = message[MSG_FIELD.MODEL]
         if isinstance(serialized, str):
             # native single-pass decode straight into the stored buffer —
@@ -487,7 +494,13 @@ def host_model(ctx: NodeContext, message: dict, conn: Connection) -> dict:
                 # encodebytes) decoded under the old permissive path and
                 # must keep working — the strict kernel is the fast path,
                 # not a contract change
-                serialized = base64.b64decode(serialized)
+                try:
+                    serialized = base64.b64decode(serialized)
+                except (binascii.Error, ValueError) as err:
+                    # formerly escaped as an untyped binascii.Error
+                    raise E.PyGridError(
+                        f"model field is not valid base64: {err}"
+                    ) from err
         elif not isinstance(serialized, bytes):
             serialized = bytes(serialized)
         return ctx.models.save(
@@ -768,8 +781,20 @@ def _servable_and_data(ctx: NodeContext, message: dict):
         return dict(_NOT_ALLOWED)
     blob = message[MSG_FIELD.DATA]
     if isinstance(blob, str):
-        blob = base64.b64decode(blob)
-    return hosted, deserialize(bytes(blob))
+        try:
+            blob = base64.b64decode(blob)
+        except (binascii.Error, ValueError) as err:
+            # formerly escaped as an untyped binascii.Error string
+            raise E.PyGridError(
+                f"data field is not valid base64: {err}"
+            ) from err
+    try:
+        payload = deserialize(bytes(blob))
+    except Exception as err:  # noqa: BLE001 — msgpack raises its own zoo
+        raise E.PyGridError(
+            f"data field is not a valid serialized payload: {err}"
+        ) from err
+    return hosted, payload
 
 
 def run_inference(ctx: NodeContext, message: dict, conn: Connection) -> dict:
@@ -938,4 +963,7 @@ def _json_bytes(obj: Any) -> str:
     instead of a 500)."""
     if isinstance(obj, (bytes, bytearray, memoryview)):
         return base64.b64encode(bytes(obj)).decode()
+    # json.dumps' default-hook contract REQUIRES TypeError (anything
+    # else aborts serialization differently)
+    # gridlint: disable-next=GL404
     raise TypeError(f"not JSON serializable: {type(obj)!r}")

@@ -41,6 +41,23 @@ def _ctx(request: web.Request) -> NodeContext:
     return request.app["node"]
 
 
+async def _off_loop(fn, *args):
+    """Run a blocking callable on the default executor — the HTTP routes'
+    door for sync WS-handler bridges and model-scale serde/base64 work
+    (gridlint GL3: one megabyte decode on the loop stalls every socket
+    the process serves). The caller's contextvars are carried across:
+    the telemetry middleware's trace span lives in a contextvar, and an
+    executor thread does not inherit it — without the copy, a bridged
+    ``report`` would record no trace on the cycle timeline."""
+    import asyncio
+    import contextvars
+
+    ctx = contextvars.copy_context()
+    return await asyncio.get_running_loop().run_in_executor(
+        None, lambda: ctx.run(fn, *args)
+    )
+
+
 def _json_error(err: Exception, status: int) -> web.Response:
     return web.json_response({"error": str(err)}, status=status)
 
@@ -71,10 +88,11 @@ async def mc_cycle_request(request: web.Request) -> web.Response:
     """HTTP mirror of the WS cycle-request (reference routes.py:37-60)."""
     try:
         body = json.loads(await request.text())
-    except json.JSONDecodeError as err:
+    except (json.JSONDecodeError, UnicodeDecodeError) as err:
         return _json_error(err, 400)
-    response = ws_cycle_request(
-        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request))
+    response = await _off_loop(
+        ws_cycle_request,
+        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request)),
     )
     return web.json_response(response[MSG_FIELD.DATA])
 
@@ -117,11 +135,14 @@ async def mc_speed_test(request: web.Request) -> web.Response:
 
 async def mc_report(request: web.Request) -> web.Response:
     try:
-        body = json.loads(await request.text())
-    except json.JSONDecodeError as err:
+        # an FL report body is megabytes of base64 diff — parsing it is
+        # CPU work the loop must not pay (same reasoning as _off_loop)
+        body = await _off_loop(json.loads, await request.text())
+    except (json.JSONDecodeError, UnicodeDecodeError) as err:
         return _json_error(err, 400)
-    response = ws_report(
-        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request))
+    response = await _off_loop(
+        ws_report,
+        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request)),
     )
     return web.json_response(response[MSG_FIELD.DATA])
 
@@ -129,10 +150,11 @@ async def mc_report(request: web.Request) -> web.Response:
 async def mc_authenticate(request: web.Request) -> web.Response:
     try:
         body = json.loads(await request.text())
-    except json.JSONDecodeError as err:
+    except (json.JSONDecodeError, UnicodeDecodeError) as err:
         return _json_error(err, 400)
-    response = ws_authenticate(
-        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request))
+    response = await _off_loop(
+        ws_authenticate,
+        _ctx(request), {MSG_FIELD.DATA: body}, Connection(_ctx(request)),
     )
     return web.json_response(response[MSG_FIELD.DATA])
 
@@ -531,7 +553,11 @@ async def dc_download_model(request: web.Request) -> web.Response:
             )
         from pygrid_tpu.serde import serialize
 
-        blob = hosted.serialized or serialize(hosted.model)
+        blob = hosted.serialized
+        if blob is None:
+            # serializing a model-scale payload on the event loop would
+            # stall every other socket (gridlint GL303)
+            blob = await _off_loop(serialize, hosted.model)
         return web.Response(
             body=blob, content_type="application/octet-stream"
         )
@@ -544,6 +570,23 @@ async def dc_serve_model(request: web.Request) -> web.Response:
     big payloads or JSON with base64 body."""
     ctx = _ctx(request)
     try:
+        # the cheap session gate FIRST: an anonymous caller must not
+        # burn executor CPU decoding a multi-megabyte body
+        _dc_session(request)
+
+        def _save(fields: dict, blob: bytes):
+            return ctx.models.save(
+                ctx.local_worker.id,
+                blob,
+                fields.get("model_id"),
+                allow_download=str(fields.get("allow_download")) == "True",
+                allow_remote_inference=str(
+                    fields.get("allow_remote_inference")
+                )
+                == "True",
+                mpc=str(fields.get("mpc")) == "True",
+            )
+
         if request.content_type.startswith("multipart/"):
             reader = await request.multipart()
             fields: dict[str, Any] = {}
@@ -553,19 +596,20 @@ async def dc_serve_model(request: web.Request) -> web.Response:
                 else:
                     fields[part.name] = (await part.text())
             blob = bytes(fields.pop("model"))
+            result = await _off_loop(_save, fields, blob)
         else:
-            fields = json.loads(await request.text())
-            blob = base64.b64decode(fields.pop("model"))
-        _dc_session(request)  # hosting requires login
-        result = ctx.models.save(
-            ctx.local_worker.id,
-            blob,
-            fields.get("model_id"),
-            allow_download=str(fields.get("allow_download")) == "True",
-            allow_remote_inference=str(fields.get("allow_remote_inference"))
-            == "True",
-            mpc=str(fields.get("mpc")) == "True",
-        )
+            # JSON parse of the megabyte body, base64 decode of its
+            # model field and the persist are all milliseconds-per-
+            # megabyte of CPU (gridlint GL303) — ONE executor hop for
+            # the lot, not three round-trips
+            text = await request.text()
+
+            def _decode_and_save():
+                fields = json.loads(text)
+                blob = base64.b64decode(fields.pop("model"))
+                return _save(fields, blob)
+
+            result = await _off_loop(_decode_and_save)
         return web.json_response(result)
     except Exception as err:  # noqa: BLE001 — HTTP boundary
         return _json_error(err, _status_for(err))
@@ -589,12 +633,9 @@ async def dc_run_generation(request: web.Request) -> web.Response:
     try:
         _dc_session(request)
         body = json.loads(await request.text())
-        loop = asyncio.get_running_loop()
         # validation deserializes the (possibly large) prompt blob —
         # off the event loop like every other blocking handler
-        prep = await loop.run_in_executor(
-            None, _prepare_generation, ctx, body
-        )
+        prep = await _off_loop(_prepare_generation, ctx, body)
         if isinstance(prep, dict):
             return web.json_response(prep, status=400)
         hosted, prompt, n_new, temperature, seed = prep

@@ -336,7 +336,8 @@ class GenerationEngine:
     # ── the device loop (engine thread only) ────────────────────────────
 
     def _ensure_thread(self) -> None:
-        # under self._lock
+        """Under the lock: both callers (enqueue's ``with self._work``
+        block) hold the engine lock while (re)spawning the worker."""
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._loop,
@@ -396,6 +397,9 @@ class GenerationEngine:
             padded[: len(row.prompt)] = row.prompt
             fn = self.programs.prefill(bucket)
             t0 = time.perf_counter()
+            # the cache buffers are single-writer: only the engine
+            # thread swaps _k/_v/_pos between lock epochs
+            # gridlint: disable-next=GL202
             tok, self._k, self._v, self._pos = fn(
                 self.params, self._k, self._v, self._pos,
                 jnp.int32(slot), jnp.asarray(padded),
@@ -437,6 +441,7 @@ class GenerationEngine:
                 keys[i] = row.keys[len(row.out)]
         fn = self.programs.decode(width)
         t0 = time.perf_counter()
+        # gridlint: disable-next=GL202 — cache buffers are engine-thread-confined
         toks, self._k, self._v, self._pos = fn(
             self.params, self._k, self._v, self._pos,
             jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
@@ -459,7 +464,10 @@ class GenerationEngine:
         its slot) when it has its n_new tokens. Returns True if freed."""
         row.out.append(token)
         row.last_token = token
-        self._tokens_out += 1
+        with self._lock:
+            # stats() reads this counter under the lock from other
+            # threads — the engine thread must not += it lock-free
+            self._tokens_out += 1
         telemetry.incr("serving_tokens_total", model=self.model_id)
         if len(row.out) < row.n_new:
             return False

@@ -175,8 +175,15 @@ def http_twin(event_type: str, ctx_key: str):
                 else {}
             )
             if not isinstance(body, dict):
-                raise ValueError("JSON object body required")
-        except (json.JSONDecodeError, ValueError) as err:
+                # typed, like every protocol-boundary defect: a bare
+                # ValueError here would be indistinguishable from an
+                # internal bug to middleware and tests (gridlint GL404)
+                raise E.PyGridError("JSON object body required")
+        except (
+            json.JSONDecodeError,
+            UnicodeDecodeError,  # request.text() on undecodable bytes
+            E.PyGridError,
+        ) as err:
             return web.json_response({ERROR: str(err)}, status=400)
         token = request.headers.get("token")
         if token and "token" not in body:

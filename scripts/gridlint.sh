@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# gridlint — the repo-native static-analysis gate (docs/ANALYSIS.md).
+# Runs the full suite over pygrid_tpu/ against the committed baseline;
+# exits non-zero on any non-baselined finding. Tier-1 runs the same
+# suite in-process via tests/unit/test_gridlint_clean.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pygrid_tpu.analysis --strict-baseline "$@"

@@ -1,0 +1,198 @@
+"""Regression tests for the gridlint GL3 route fixes.
+
+``mc_report`` / ``mc_cycle_request`` / ``mc_authenticate`` now bridge
+their sync WS handlers through the executor, ``dc_serve_model`` decodes
+and persists off-loop, and ``dc_download_model`` serializes off-loop —
+these tests prove the routes still serve their full contract through
+the executor door, and that the event loop stays responsive WHILE a
+model-scale upload is being processed (the property the fixes exist
+for)."""
+
+from __future__ import annotations
+
+import base64
+import concurrent.futures
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from pygrid_tpu.models import mlp
+from pygrid_tpu.serde import deserialize, serialize
+
+from .conftest import ServerThread, _free_port
+
+
+@pytest.fixture(scope="module")
+def node():
+    from pygrid_tpu.federated import tasks
+    from pygrid_tpu.node import create_app
+
+    prev = tasks._sync
+    tasks.set_sync(True)
+    server = ServerThread(create_app("async-routes-node"), _free_port()).start()
+    yield server
+    tasks.set_sync(prev)
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def token(node):
+    # the data-centric session normally comes from the WS
+    # `authentication` event; mint one directly from the seeded admin —
+    # these tests exercise the HTTP routes, not the login protocol
+    _session, tok = node.app["node"].sessions.login("admin", "admin")
+    return tok
+
+
+def _params_blob():
+    params = mlp.init(jax.random.PRNGKey(1), (6, 4, 2))
+    return serialize([np.asarray(p) for p in params])
+
+
+def test_serve_and_download_model_roundtrip_off_loop(node, token):
+    """JSON serve-model (b64decode + save now on the executor) then the
+    download twin (serialize now on the executor) — bytes must round-trip
+    exactly."""
+    blob = _params_blob()
+    resp = requests.post(
+        node.url + "/data-centric/serve-model/",
+        json={
+            "model": base64.b64encode(blob).decode(),
+            "model_id": "exec-model",
+            "allow_download": "True",
+        },
+        headers={"token": token},
+        timeout=30,
+    )
+    assert resp.status_code == 200, resp.text
+    assert resp.json().get("success"), resp.text
+
+    resp = requests.get(
+        node.url + "/data-centric/serve-model/",
+        params={"model_id": "exec-model"},
+        headers={"token": token},
+        timeout=30,
+    )
+    assert resp.status_code == 200, resp.text
+    got = deserialize(resp.content)
+    want = deserialize(blob)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_mc_routes_still_answer_their_contract(node):
+    """The executor-bridged model-centric routes keep their response
+    shapes: authenticate for an unknown process answers the typed error
+    envelope; report with a bad key answers typed; cycle-request with
+    no such model answers rejected."""
+    resp = requests.post(
+        node.url + "/model-centric/authenticate",
+        data=json.dumps({"model_name": "nope", "model_version": "0"}),
+        timeout=10,
+    )
+    assert resp.status_code == 200
+    assert "error" in resp.json()
+
+    resp = requests.post(
+        node.url + "/model-centric/cycle-request",
+        data=json.dumps(
+            {
+                "worker_id": "w-missing",
+                "model": "nope",
+                "version": "0",
+                "ping": 1,
+                "download": 1,
+                "upload": 1,
+            }
+        ),
+        timeout=10,
+    )
+    assert resp.status_code == 200
+    assert resp.json().get("status") == "rejected"
+
+    resp = requests.post(
+        node.url + "/model-centric/report",
+        data=json.dumps(
+            {"worker_id": "w-missing", "request_key": "k", "diff": ""}
+        ),
+        timeout=10,
+    )
+    assert resp.status_code == 200
+    assert "error" in resp.json()
+
+
+def test_mc_routes_answer_400_for_undecodable_bodies(node):
+    """Bytes that are invalid UTF-8 under the declared charset raise
+    UnicodeDecodeError from request.text() — a client defect that must
+    stay a 400, never a 500 traceback."""
+    for route in (
+        "/model-centric/report",
+        "/model-centric/authenticate",
+        "/model-centric/cycle-request",
+    ):
+        resp = requests.post(
+            node.url + route,
+            data=b"\xff\xfe{",
+            headers={"Content-Type": "application/json; charset=utf-8"},
+            timeout=10,
+        )
+        assert resp.status_code == 400, (route, resp.status_code, resp.text)
+
+
+def test_event_loop_stays_responsive_during_big_upload(node, token):
+    """While a multi-megabyte serve-model body is decoded and persisted
+    (executor work after the fix), a concurrent /data-centric/status/
+    probe must answer promptly — the loop is free to serve it."""
+    big = serialize(
+        [np.random.RandomState(0).rand(512, 512).astype(np.float32)
+         for _ in range(4)]
+    )
+    body = {
+        "model": base64.b64encode(big).decode(),
+        "model_id": "big-model",
+    }
+
+    status_latencies: list[float] = []
+    stop = threading.Event()
+
+    def probe():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            r = requests.get(
+                node.url + "/data-centric/status/", timeout=10
+            )
+            status_latencies.append(time.perf_counter() - t0)
+            assert r.status_code == 200
+            time.sleep(0.01)
+
+    prober = threading.Thread(target=probe, daemon=True)
+    prober.start()
+    try:
+        with concurrent.futures.ThreadPoolExecutor(4) as pool:
+            uploads = [
+                pool.submit(
+                    requests.post,
+                    node.url + "/data-centric/serve-model/",
+                    json=dict(body, model_id=f"big-{i}"),
+                    headers={"token": token},
+                    timeout=60,
+                )
+                for i in range(4)
+            ]
+            for fut in uploads:
+                resp = fut.result()
+                assert resp.status_code == 200, resp.text
+    finally:
+        stop.set()
+        prober.join(timeout=10)
+    assert status_latencies, "probe thread never sampled"
+    # generous bound: the loop must never be pinned for the length of a
+    # megabyte decode+persist (which takes well under a second each; a
+    # BLOCKED loop would show multi-upload-long stalls)
+    assert max(status_latencies) < 2.0, max(status_latencies)

@@ -1,0 +1,697 @@
+"""gridlint checker semantics, fixture-driven.
+
+Every rule is asserted POSITIVELY (a known-bad snippet fires) and
+NEGATIVELY (a known-good snippet stays quiet) — findings are proven,
+not hoped for. Suppression directives and baseline mechanics get the
+same treatment: a ``# gridlint: disable=`` line must report
+*suppressed*, a too-generous baseline must report *stale*.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from pygrid_tpu.analysis import run_checks
+from pygrid_tpu.analysis.checkers import (
+    AsyncHygieneChecker,
+    ContractDriftChecker,
+    LockDisciplineChecker,
+    TraceSafetyChecker,
+)
+
+
+def _lint(tmp_path, source, checker_cls=None, rel="pkg/mod.py", files=None):
+    """Write fixture file(s) under tmp_path and run the suite (no
+    baseline) rooted there. Returns the RunResult."""
+    (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+    all_files = dict(files or {})
+    if source is not None:
+        all_files[rel] = source
+    for path, text in all_files.items():
+        f = tmp_path / path
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(text))
+    checkers = [checker_cls()] if checker_cls else None
+    return run_checks(
+        [str(tmp_path)], checkers=checkers, baseline_path="",
+        root=str(tmp_path),
+    )
+
+
+def _codes(result):
+    return sorted(f.code for f in result.failures)
+
+
+# ── GL1 trace-safety ─────────────────────────────────────────────────────
+
+
+class TestGL1:
+    def test_side_effects_in_jit_wrapped_function_fire(self, tmp_path):
+        res = _lint(tmp_path, """
+            import time
+            import jax
+            from pygrid_tpu import telemetry
+
+            def traced(x):
+                print("tracing!")
+                telemetry.incr("calls_total")
+                t0 = time.perf_counter()
+                return x + t0
+
+            fn = jax.jit(traced)
+        """, TraceSafetyChecker)
+        assert _codes(res).count("GL101") == 3
+
+    def test_decorated_and_partial_jit_fire(self, tmp_path):
+        res = _lint(tmp_path, """
+            from functools import partial
+            import jax
+
+            @jax.jit
+            def a(x):
+                print("a")
+                return x
+
+            @partial(jax.jit, static_argnums=0)
+            def b(x):
+                print("b")
+                return x
+        """, TraceSafetyChecker)
+        assert _codes(res) == ["GL101", "GL101"]
+
+    def test_reachable_helper_and_method_fire(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def helper(x):
+                print("inside the trace, transitively")
+                return x
+
+            class Programs:
+                def _pick(self, x):
+                    print("method side-effect")
+                    return x
+
+                def build(self):
+                    def _step(params, x):
+                        y = helper(x)
+                        return self._pick(y)
+
+                    return jax.jit(_step)
+        """, TraceSafetyChecker)
+        assert _codes(res) == ["GL101", "GL101"]
+
+    def test_item_host_sync_fires_GL102(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def traced(x):
+                n = x.sum().item()
+                return n
+
+            fn = jax.jit(traced)
+        """, TraceSafetyChecker)
+        assert _codes(res) == ["GL102"]
+
+    def test_lock_acquisition_in_trace_fires(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def traced(self, x):
+                with self._lock:
+                    return x
+
+            fn = jax.jit(traced)
+        """, TraceSafetyChecker)
+        assert _codes(res) == ["GL101"]
+
+    def test_jit_per_call_and_jit_in_loop_fire_GL103(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def g(x):
+                return x
+
+            def serve(x):
+                y = jax.jit(lambda v: v + 1)(x)
+                fns = []
+                for _ in range(3):
+                    fns.append(jax.jit(g))
+                return y, fns
+        """, TraceSafetyChecker)
+        assert _codes(res) == ["GL103", "GL103"]
+
+    def test_clean_jitted_function_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def traced(params, x):
+                h = jnp.tanh(x @ params)
+                return h.sum()
+
+            fn = jax.jit(traced)
+
+            def host_side():
+                # side-effects OUTSIDE any trace are fine
+                print("serving")
+                return fn
+        """, TraceSafetyChecker)
+        assert res.failures == []
+
+
+# ── GL2 thread/lock discipline ───────────────────────────────────────────
+
+
+_GL2_RACY = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def safe_add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def racy_add(self, x):
+            self._items.append(x)
+"""
+
+
+class TestGL2:
+    def test_unlocked_mutation_fires_GL202(self, tmp_path):
+        res = _lint(tmp_path, _GL2_RACY, LockDisciplineChecker)
+        assert _codes(res) == ["GL202"]
+        (finding,) = res.failures
+        assert "racy" not in finding.message  # message names attr, not fn
+        assert "_items" in finding.message
+
+    def test_never_guarded_attr_is_thread_confined(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._queue = []
+                    self._cache = None
+
+                def guarded(self, x):
+                    with self._lock:
+                        self._queue.append(x)
+
+                def engine_thread_only(self, v):
+                    # _cache is never touched under the lock anywhere —
+                    # treated as single-thread-confined by design
+                    self._cache = v
+        """, LockDisciplineChecker)
+        assert res.failures == []
+
+    def test_locked_suffix_and_docstring_conventions_exempt(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}
+
+                def get(self, k):
+                    with self._lock:
+                        return self._mutate_locked(k)
+
+                def _mutate_locked(self, k):
+                    self._state[k] = 1
+                    return 1
+
+                def _drop(self, k):
+                    \"\"\"Under the lock: callers own it.\"\"\"
+                    self._state.pop(k, None)
+        """, LockDisciplineChecker)
+        assert res.failures == []
+
+    def test_lock_order_cycle_fires_GL201(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class AB:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._x = 0
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            self._x += 1
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            self._x -= 1
+        """, LockDisciplineChecker)
+        assert "GL201" in _codes(res)
+        assert any("cycle" in f.message for f in res.failures)
+
+    def test_consistent_lock_order_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class AB:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._x = 0
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            self._x += 1
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            self._x -= 1
+        """, LockDisciplineChecker)
+        assert res.failures == []
+
+    def test_condition_alias_self_deadlock_fires_GL203(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._work = threading.Condition(self._lock)
+
+                def bad(self):
+                    with self._lock:
+                        with self._work:
+                            pass
+        """, LockDisciplineChecker)
+        assert _codes(res) == ["GL203"]
+        assert "wraps" in res.failures[0].message
+
+    def test_rlock_reacquire_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def reentrant(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """, LockDisciplineChecker)
+        assert res.failures == []
+
+
+# ── GL3 async hygiene ────────────────────────────────────────────────────
+
+
+class TestGL3:
+    def test_blocking_calls_in_async_def_fire(self, tmp_path):
+        res = _lint(tmp_path, """
+            import time
+            import requests
+
+            async def handler(request):
+                time.sleep(0.1)
+                requests.get("http://x")
+                return None
+        """, AsyncHygieneChecker)
+        assert _codes(res) == ["GL301", "GL301"]
+
+    def test_future_result_and_queue_get_fire_GL302(self, tmp_path):
+        res = _lint(tmp_path, """
+            async def handler(self, request):
+                value = self.future.result(30)
+                item = self._q.get()
+                return value, item
+        """, AsyncHygieneChecker)
+        assert _codes(res) == ["GL302", "GL302"]
+
+    def test_serde_on_the_loop_fires_GL303(self, tmp_path):
+        res = _lint(tmp_path, """
+            import base64
+            from pygrid_tpu.serde import serialize
+
+            async def handler(request, model):
+                blob = serialize(model)
+                raw = base64.b64decode(blob)
+                return raw
+        """, AsyncHygieneChecker)
+        assert _codes(res) == ["GL303", "GL303"]
+
+    def test_nested_sync_def_and_executor_are_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import asyncio
+            import time
+            from pygrid_tpu.serde import serialize
+
+            def plain(model):
+                # sync code may block: it runs wherever its caller puts it
+                time.sleep(0.1)
+                return serialize(model)
+
+            async def handler(request, model):
+                loop = asyncio.get_running_loop()
+                blob = await loop.run_in_executor(
+                    None, lambda: serialize(model)
+                )
+                return await loop.run_in_executor(None, plain, model)
+        """, AsyncHygieneChecker)
+        assert res.failures == []
+
+
+# ── GL4 contract drift ───────────────────────────────────────────────────
+
+
+_GL4_BUS = """
+    _FAMILY_HELP = {
+        "documented_total": "a documented family",
+        "undocumented_seconds": "in help but not in docs",
+    }
+"""
+
+_GL4_DOCS = """
+    # Observability
+    | `pygrid_documented_total` | counter | - |
+"""
+
+
+class TestGL4:
+    def test_undocumented_metric_fires_GL401(self, tmp_path):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "docs/OBSERVABILITY.md": _GL4_DOCS,
+            "pkg/telemetry/bus.py": _GL4_BUS,
+            "pkg/app.py": """
+                from pygrid_tpu import telemetry
+
+                def serve():
+                    telemetry.incr("documented_total")
+                    telemetry.observe("undocumented_seconds", 0.1)
+            """,
+        })
+        assert _codes(res) == ["GL401"]
+        assert "undocumented_seconds" in res.failures[0].message
+
+    def test_missing_family_help_fires_GL402(self, tmp_path):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "docs/OBSERVABILITY.md": (
+                _GL4_DOCS + "    | `pygrid_orphan_total` | counter | - |\n"
+            ),
+            "pkg/telemetry/bus.py": _GL4_BUS,
+            "pkg/app.py": """
+                from pygrid_tpu import telemetry
+
+                def serve():
+                    telemetry.incr("orphan_total")
+            """,
+        })
+        assert _codes(res) == ["GL402"]
+        assert "orphan_total" in res.failures[0].message
+
+    def test_wire_constant_duplicate_and_undocumented_fire_GL403(
+        self, tmp_path
+    ):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "docs/WIRE.md": "tags: 0x01 and 0x02 only\n",
+            "pkg/serde/wire.py": """
+                EXT_NDARRAY = 0x01
+                EXT_OBJECT = 0x02
+                EXT_CLASH = 0x01     # duplicate tag byte
+                FRAME_SECRET = 0x07  # not in docs/WIRE.md
+            """,
+        })
+        codes = _codes(res)
+        assert codes.count("GL403") == 2  # the dup + the undocumented tag
+        messages = " ".join(f.message for f in res.failures)
+        assert "duplicates" in messages and "FRAME_SECRET" in messages
+
+    def test_subprotocol_string_checked_against_docs(self, tmp_path):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "docs/WIRE.md": "`pygrid.wire.v2` is the only token. 0x01\n",
+            "pkg/serde/wire.py": """
+                WS_SUBPROTOCOL_V2 = "pygrid.wire.v2"
+                WS_SUBPROTOCOL_V3 = "pygrid.wire.v3"
+            """,
+        })
+        assert _codes(res) == ["GL403"]
+        assert "pygrid.wire.v3" in res.failures[0].message
+
+    def test_bare_raise_in_handler_module_fires_GL404(self, tmp_path):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "pkg/node/events.py": """
+                def handler(ctx, message, conn):
+                    if "x" not in message:
+                        raise ValueError("missing x")
+                    return {}
+            """,
+            # the same raise OUTSIDE a handler module is not GL4's business
+            "pkg/smpc/kernels.py": """
+                def kernel(x):
+                    raise ValueError("shape mismatch")
+            """,
+        })
+        assert _codes(res) == ["GL404"]
+        assert res.failures[0].path.endswith("node/events.py")
+
+    def test_without_docs_dir_membership_rules_stay_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            from pygrid_tpu import telemetry
+
+            def serve():
+                telemetry.incr("anything_total")
+        """, ContractDriftChecker)
+        assert res.failures == []
+
+
+# ── suppression + baseline mechanics ─────────────────────────────────────
+
+
+class TestSuppression:
+    def test_inline_disable_reports_suppressed(self, tmp_path):
+        # rpartition targets the LAST occurrence — the unlocked append
+        head, _, tail = _GL2_RACY.rpartition("self._items.append(x)")
+        src = head + "self._items.append(x)  # gridlint: disable=GL202" + tail
+        res = _lint(tmp_path, src, LockDisciplineChecker)
+        assert res.failures == []
+        assert [f.code for f in res.suppressed] == ["GL202"]
+
+    def test_disable_next_line_covers_following_statement(self, tmp_path):
+        head, _, tail = _GL2_RACY.rpartition("self._items.append(x)")
+        src = (
+            head
+            + "# gridlint: disable-next=GL202\n            "
+            + "self._items.append(x)"
+            + tail
+        )
+        res = _lint(tmp_path, src, LockDisciplineChecker)
+        assert res.failures == []
+        assert [f.code for f in res.suppressed] == ["GL202"]
+
+    def test_disable_family_and_all(self, tmp_path):
+        for directive in ("GL2", "all"):
+            head, _, tail = _GL2_RACY.rpartition("self._items.append(x)")
+            src = (
+                head
+                + f"self._items.append(x)  # gridlint: disable={directive}"
+                + tail
+            )
+            res = _lint(tmp_path, src, LockDisciplineChecker)
+            assert res.failures == [], directive
+            assert len(res.suppressed) == 1
+
+    def test_skip_file_opts_a_module_out(self, tmp_path):
+        src = "# gridlint: skip-file\n" + textwrap.dedent(_GL2_RACY)
+        res = _lint(tmp_path, src, LockDisciplineChecker)
+        assert res.failures == [] and res.suppressed == []
+        assert res.files_checked == 0
+
+    def test_unrelated_code_is_not_suppressed(self, tmp_path):
+        head, _, tail = _GL2_RACY.rpartition("self._items.append(x)")
+        src = (
+            head
+            + "self._items.append(x)  # gridlint: disable=GL301"
+            + tail
+        )
+        res = _lint(tmp_path, src, LockDisciplineChecker)
+        assert _codes(res) == ["GL202"]
+
+
+class TestBaseline:
+    def _run_with_baseline(self, tmp_path, count):
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        mod = tmp_path / "pkg" / "mod.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(textwrap.dedent(_GL2_RACY))
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({
+            "version": 1,
+            "entries": [
+                {
+                    "path": "pkg/mod.py",
+                    "code": "GL202",
+                    "count": count,
+                    "note": "pre-existing; engine-thread-confined",
+                }
+            ],
+        }))
+        return run_checks(
+            [str(tmp_path)],
+            checkers=[LockDisciplineChecker()],
+            baseline_path=str(baseline),
+            root=str(tmp_path),
+        )
+
+    def test_exact_baseline_passes_without_stale(self, tmp_path):
+        res = self._run_with_baseline(tmp_path, count=1)
+        assert res.ok and res.failures == []
+        assert [f.code for f in res.baselined] == ["GL202"]
+        assert res.stale_baseline == []
+
+    def test_stale_baseline_is_reported(self, tmp_path):
+        res = self._run_with_baseline(tmp_path, count=3)
+        assert res.failures == []
+        assert len(res.stale_baseline) == 1
+        assert "3" in res.stale_baseline[0]
+        assert "shrink" in res.stale_baseline[0]
+
+    def test_entry_for_healed_file_is_stale(self, tmp_path):
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        mod = tmp_path / "pkg" / "clean.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text("x = 1\n")
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({
+            "version": 1,
+            "entries": [
+                {"path": "pkg/clean.py", "code": "GL202", "count": 2},
+            ],
+        }))
+        res = run_checks(
+            [str(tmp_path)],
+            checkers=[LockDisciplineChecker()],
+            baseline_path=str(baseline),
+            root=str(tmp_path),
+        )
+        assert res.failures == []
+        assert len(res.stale_baseline) == 1
+        assert "remove the entry" in res.stale_baseline[0]
+
+    def test_findings_beyond_allowance_fail(self, tmp_path):
+        res = self._run_with_baseline(tmp_path, count=0)
+        assert not res.ok
+        assert _codes(res) == ["GL202"]
+
+    def test_baseline_not_stale_when_its_checker_did_not_run(
+        self, tmp_path
+    ):
+        """`--select GL1` must not call a GL202 allowance stale (the
+        entry's checker never ran), and a subset-target run must not
+        call allowances for unscanned files stale."""
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        mod = tmp_path / "pkg" / "mod.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(textwrap.dedent(_GL2_RACY))
+        other = tmp_path / "other" / "x.py"
+        other.parent.mkdir(parents=True)
+        other.write_text("x = 1\n")
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({
+            "version": 1,
+            "entries": [
+                {"path": "pkg/mod.py", "code": "GL202", "count": 1},
+            ],
+        }))
+        # GL2 deselected: the allowance is invisible, not stale
+        res = run_checks(
+            [str(tmp_path)], checkers=[TraceSafetyChecker()],
+            baseline_path=str(baseline), root=str(tmp_path),
+        )
+        assert res.ok and res.stale_baseline == []
+        # pkg/mod.py not scanned: the allowance is out of scope, not stale
+        res = run_checks(
+            [str(other.parent)], checkers=[LockDisciplineChecker()],
+            baseline_path=str(baseline), root=str(tmp_path),
+        )
+        assert res.ok and res.stale_baseline == []
+
+
+# ── CLI ──────────────────────────────────────────────────────────────────
+
+
+class TestCLI:
+    def test_exit_codes_and_output(self, tmp_path, capsys):
+        from pygrid_tpu.analysis.cli import main
+
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        bad = tmp_path / "pkg" / "mod.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(textwrap.dedent(_GL2_RACY))
+        rc = main([str(tmp_path), "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "GL202" in out and "pkg/mod.py" in out
+
+        bad.write_text("x = 1\n")
+        rc = main([str(tmp_path), "--no-baseline"])
+        assert rc == 0
+
+    def test_select_unknown_checker_is_usage_error(self, tmp_path, capsys):
+        from pygrid_tpu.analysis.cli import main
+
+        assert main([str(tmp_path), "--select", "GL9"]) == 2
+
+    def test_nonexistent_target_is_usage_error_not_clean(
+        self, tmp_path, capsys
+    ):
+        from pygrid_tpu.analysis.cli import main
+
+        # a typo'd path must not report "0 files, 0 findings" and pass
+        assert main([str(tmp_path / "no_such_dir")]) == 2
+        assert "no such target" in capsys.readouterr().err
+
+    def test_strict_baseline_fails_on_stale(self, tmp_path, capsys):
+        from pygrid_tpu.analysis.cli import main
+
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        mod = tmp_path / "pkg" / "clean.py"
+        mod.parent.mkdir(parents=True)
+        mod.write_text("x = 1\n")
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({
+            "version": 1,
+            "entries": [
+                {"path": "pkg/clean.py", "code": "GL202", "count": 1},
+            ],
+        }))
+        assert main([str(tmp_path), "--baseline", str(baseline)]) == 0
+        assert (
+            main(
+                [
+                    str(tmp_path),
+                    "--baseline", str(baseline),
+                    "--strict-baseline",
+                ]
+            )
+            == 1
+        )
+        out = capsys.readouterr().out
+        assert "stale baseline" in out
+
+    def test_list_checkers_catalogue(self, capsys):
+        from pygrid_tpu.analysis.cli import main
+
+        assert main(["--list-checkers"]) == 0
+        out = capsys.readouterr().out
+        for code in ("GL101", "GL201", "GL301", "GL401"):
+            assert code in out

@@ -1,0 +1,42 @@
+"""Tier-1 gate: the full gridlint suite over ``pygrid_tpu/`` is clean.
+
+This is the mechanical enforcement the checkers exist for: any
+non-baselined finding (or a stale baseline entry — allowances must
+ratchet DOWN as code heals) fails the build. The run is also timed:
+the suite must stay cheap enough that nobody is tempted to skip it
+(< 10 s over the whole tree; it measures ~1 s today).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+from pygrid_tpu.analysis import run_checks
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def test_gridlint_suite_is_clean_and_fast():
+    t0 = time.perf_counter()
+    result = run_checks([str(REPO_ROOT / "pygrid_tpu")])
+    elapsed = time.perf_counter() - t0
+
+    assert result.parse_errors == [], result.parse_errors
+    assert result.failures == [], "\n".join(
+        f.render() for f in result.failures
+    )
+    # stale allowances mask future regressions — shrink baseline.json
+    assert result.stale_baseline == [], "\n".join(result.stale_baseline)
+    assert result.files_checked > 100  # the walk actually saw the tree
+    assert elapsed < 10.0, f"gridlint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_gridlint_cli_entrypoint_is_clean():
+    """`python -m pygrid_tpu.analysis pygrid_tpu/` exits 0 on the final
+    tree — the same invocation scripts/gridlint.sh ships."""
+    from pygrid_tpu.analysis.cli import main
+
+    assert (
+        main([str(REPO_ROOT / "pygrid_tpu"), "--strict-baseline", "-q"]) == 0
+    )

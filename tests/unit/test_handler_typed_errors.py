@@ -1,0 +1,182 @@
+"""Typed-error contract of the host-model / run-generation handlers.
+
+Regression tests for the gridlint GL4 satellite audit: client defects
+that formerly escaped as untyped ``KeyError``/``binascii.Error``
+strings through the dispatch boundary now answer typed PyGridError
+messages — ``{success: False, error: <actionable text>}`` — and the
+users HTTP twin's body validation raises typed instead of a bare
+``ValueError``.
+"""
+
+from __future__ import annotations
+
+import base64
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from pygrid_tpu.models import decode
+from pygrid_tpu.models import transformer as T
+from pygrid_tpu.node import NodeContext
+from pygrid_tpu.node.events import Connection, host_model, run_generation
+from pygrid_tpu.serde import serialize
+
+CFG = T.TransformerConfig(
+    vocab=23, d_model=8, n_heads=2, n_layers=1, d_ff=16, max_len=32
+)
+
+
+@pytest.fixture(scope="module")
+def ctx_conn():
+    ctx = NodeContext("typed-errors-node")
+    conn = Connection(ctx, socket=object())
+    conn.session = SimpleNamespace(worker=None)
+    return ctx, conn
+
+
+def test_host_model_missing_fields_answer_typed(ctx_conn):
+    ctx, conn = ctx_conn
+    # formerly: KeyError('model') escaped to the dispatch boundary and
+    # the client saw the cryptic string "'model'"
+    out = host_model(ctx, {"model_id": "m1"}, conn)
+    assert out.get("success") is False
+    assert "missing required field" in out["error"]
+    out = host_model(ctx, {"model": "QUJD"}, conn)
+    assert out.get("success") is False
+    assert "missing required field" in out["error"]
+
+
+def test_host_model_invalid_base64_answers_typed(ctx_conn):
+    ctx, conn = ctx_conn
+    # strict-kernel rejection + stdlib rejection → typed message (was an
+    # untyped binascii.Error string)
+    out = host_model(
+        ctx, {"model": "!!not-base64!!", "model_id": "m2"}, conn
+    )
+    assert out.get("success") is False
+    assert "not valid base64" in out["error"]
+
+
+@pytest.fixture(scope="module")
+def hosted_gen(ctx_conn):
+    ctx, conn = ctx_conn
+    params = T.init(jax.random.PRNGKey(0), CFG)
+    result = host_model(
+        ctx,
+        {
+            "model": base64.b64encode(
+                serialize(decode.bundle(CFG, params))
+            ).decode(),
+            "model_id": "gen-typed",
+            "allow_remote_inference": "True",
+        },
+        conn,
+    )
+    assert result.get("success"), result
+    return "gen-typed"
+
+
+def test_run_generation_bad_base64_data_answers_typed(
+    ctx_conn, hosted_gen
+):
+    ctx, conn = ctx_conn
+    out = run_generation(
+        ctx,
+        {"model_id": hosted_gen, "data": "%%%garbage%%%", "n_new": 2},
+        conn,
+    )
+    assert out.get("success") is False
+    assert "not valid base64" in out["error"]
+
+
+def test_run_generation_garbage_payload_answers_typed(
+    ctx_conn, hosted_gen
+):
+    ctx, conn = ctx_conn
+    # valid base64, but the decoded bytes are not a serde payload —
+    # formerly msgpack's exception zoo escaped untyped
+    out = run_generation(
+        ctx,
+        {
+            "model_id": hosted_gen,
+            "data": base64.b64encode(b"\xc1\xff\x00raw-noise").decode(),
+            "n_new": 2,
+        },
+        conn,
+    )
+    assert out.get("success") is False
+    assert "not a valid serialized payload" in out["error"]
+
+
+def test_run_generation_still_serves_after_typed_rejections(
+    ctx_conn, hosted_gen
+):
+    ctx, conn = ctx_conn
+    prompt = np.array([[1, 2, 3]], np.int32)
+    out = run_generation(
+        ctx,
+        {
+            "model_id": hosted_gen,
+            "data": base64.b64encode(serialize(prompt)).decode(),
+            "n_new": 3,
+        },
+        conn,
+    )
+    assert out.get("success") is True, out
+    assert np.asarray(out["tokens"]).shape == (1, 3)
+
+
+def test_users_http_twin_rejects_non_object_body_typed():
+    """The users HTTP twin raises typed PyGridError for a non-object
+    JSON body (was a bare ValueError — gridlint GL404) and still maps
+    it to a 400 response."""
+    import asyncio
+
+    from pygrid_tpu.users.events import http_twin
+    from pygrid_tpu.utils.codes import USER_EVENTS
+
+    handler = http_twin(USER_EVENTS.LOGIN_USER, "node")
+
+    class _Req:
+        can_read_body = True
+        headers: dict = {}
+        match_info: dict = {}
+
+        def __init__(self):
+            self.app = {"node": None}
+
+        async def text(self):
+            return "[1, 2, 3]"  # JSON, but not an object
+
+    resp = asyncio.run(handler(_Req()))
+    assert resp.status == 400
+    assert b"JSON object body required" in resp.body
+
+
+def test_users_http_twin_undecodable_body_is_400_not_500():
+    """``request.text()`` raising UnicodeDecodeError (undecodable bytes
+    under the declared charset) is a client defect and must stay a 400,
+    not escape as a 500."""
+    import asyncio
+
+    from pygrid_tpu.users.events import http_twin
+    from pygrid_tpu.utils.codes import USER_EVENTS
+
+    handler = http_twin(USER_EVENTS.LOGIN_USER, "node")
+
+    class _Req:
+        can_read_body = True
+        headers: dict = {}
+        match_info: dict = {}
+
+        def __init__(self):
+            self.app = {"node": None}
+
+        async def text(self):
+            return b"\xff\xfe".decode("utf-8")  # raises UnicodeDecodeError
+
+    resp = asyncio.run(handler(_Req()))
+    assert resp.status == 400
