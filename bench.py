@@ -1399,6 +1399,15 @@ def bench_wire(tiny: bool = False) -> dict:
     return out
 
 
+def _restore_env(name: str, prev: str | None) -> None:
+    import os
+
+    if prev is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = prev
+
+
 def bench_telemetry_overhead(tiny: bool = False) -> dict:
     """Cost of the always-on telemetry on the wire hot loop: one
     model-download + diff-upload round (the bench_wire framing) measured
@@ -1450,7 +1459,26 @@ def bench_telemetry_overhead(tiny: bool = False) -> dict:
         }), trace=tb)
         return down, up
 
-    def _round(instrumented: bool) -> None:
+    import os
+
+    from pygrid_tpu.telemetry import profiler, recorder
+
+    # the profiler+recorder layer as the live path pays it: every frame
+    # makes one profiler-wrapped call (timing + jit-cache check + bus
+    # histogram) and one flight-recorder ring append. Two wrapped
+    # probes: one built with the layer ON, one with PYGRID_PROFILER=off
+    # (wrap() is then the identity — the disabled cost under test).
+    flight_on = profiler.wrap(lambda frame: frame, kind="bench", bucket=0)
+    prev_prof = os.environ.get("PYGRID_PROFILER")
+    os.environ["PYGRID_PROFILER"] = "off"
+    try:
+        flight_off = profiler.wrap(
+            lambda frame: frame, kind="bench", bucket=1
+        )
+    finally:
+        _restore_env("PYGRID_PROFILER", prev_prof)
+
+    def _round(instrumented: bool, flight_fn=None) -> None:
         if instrumented:
             with trace.span("client.request", event_type="bench"):
                 down, up = _frames(True)
@@ -1464,6 +1492,9 @@ def bench_telemetry_overhead(tiny: bool = False) -> dict:
                 telemetry.observe(
                     "ws_frame_decode_seconds", time.perf_counter() - t0
                 )
+                if flight_fn is not None:
+                    flight_fn(frame)
+                    recorder.note("bench.frame", n_bytes=len(frame))
                 with trace.serve(trace.from_bytes(tb)):
                     deserialize(payload)
         else:
@@ -1471,13 +1502,20 @@ def bench_telemetry_overhead(tiny: bool = False) -> dict:
             for frame in (down, up):
                 deserialize(decode_frame_traced(frame)[0])
 
-    # genuinely interleaved A/B (one plain, one traced, repeat) so drift
-    # on a busy capture host hits both variants the same way, with one
-    # untimed warmup pair absorbing allocator/import one-offs
+    # genuinely interleaved A/B/C/D (plain, traced, traced+flight,
+    # traced+flight-disabled, repeat) so drift on a busy capture host
+    # hits every variant the same way, with one untimed warmup pass
+    # absorbing allocator/import one-offs. The D variant runs the SAME
+    # layer call sites with both off-switches thrown — "≈0% when
+    # disabled" measured, not vowed.
     _round(False)
     _round(True)
+    _round(True, flight_on)
     plain_times: list[float] = []
     traced_times: list[float] = []
+    flight_times: list[float] = []
+    disabled_times: list[float] = []
+    prev_flight = os.environ.get("PYGRID_FLIGHT")
     for _ in range(repeats):
         t0 = time.perf_counter()
         _round(False)
@@ -1485,8 +1523,24 @@ def bench_telemetry_overhead(tiny: bool = False) -> dict:
         t0 = time.perf_counter()
         _round(True)
         traced_times.append(time.perf_counter() - t0)
-    plain_ms = sorted(plain_times)[len(plain_times) // 2] * 1e3
-    traced_ms = sorted(traced_times)[len(traced_times) // 2] * 1e3
+        t0 = time.perf_counter()
+        _round(True, flight_on)
+        flight_times.append(time.perf_counter() - t0)
+        os.environ["PYGRID_FLIGHT"] = "off"
+        try:
+            t0 = time.perf_counter()
+            _round(True, flight_off)
+            disabled_times.append(time.perf_counter() - t0)
+        finally:
+            _restore_env("PYGRID_FLIGHT", prev_flight)
+
+    def _p50_ms(times: list[float]) -> float:
+        return sorted(times)[len(times) // 2] * 1e3
+
+    plain_ms = _p50_ms(plain_times)
+    traced_ms = _p50_ms(traced_times)
+    flight_ms = _p50_ms(flight_times)
+    disabled_ms = _p50_ms(disabled_times)
 
     with trace.span("client.request", event_type="bench"):
         d_t, u_t = _frames(True)
@@ -1495,6 +1549,8 @@ def bench_telemetry_overhead(tiny: bool = False) -> dict:
     bytes_traced = len(d_t) + len(u_t)
     byte_pct = 100.0 * (bytes_traced - bytes_plain) / bytes_plain
     latency_pct = 100.0 * (traced_ms - plain_ms) / plain_ms
+    flight_pct = 100.0 * (flight_ms - traced_ms) / traced_ms
+    disabled_pct = 100.0 * (disabled_ms - traced_ms) / traced_ms
     out = {
         "telemetry_roundtrip_bytes_plain": bytes_plain,
         "telemetry_roundtrip_bytes_traced": bytes_traced,
@@ -1502,11 +1558,30 @@ def bench_telemetry_overhead(tiny: bool = False) -> dict:
         "telemetry_roundtrip_ms_plain": round(plain_ms, 3),
         "telemetry_roundtrip_ms_traced": round(traced_ms, 3),
         "telemetry_latency_overhead_pct": round(latency_pct, 2),
-        "telemetry_within_2pct": bool(byte_pct <= 2.0 and latency_pct <= 2.0),
+        "telemetry_roundtrip_ms_flight": round(flight_ms, 3),
+        "telemetry_flight_overhead_pct": round(flight_pct, 2),
+        "telemetry_roundtrip_ms_flight_disabled": round(disabled_ms, 3),
+        "telemetry_flight_disabled_overhead_pct": round(disabled_pct, 2),
+        # on the tiny CI shapes the flight percentages are p50-minus-p50
+        # noise over ~50µs rounds (the unit twin gates on ABSOLUTE
+        # bounds for the same reason) — hold the layer to the absolute
+        # budget there and to the ≤2% criterion at checkpoint scale
+        "telemetry_within_2pct": bool(
+            byte_pct <= 2.0
+            and latency_pct <= 2.0
+            and (
+                (flight_ms - traced_ms < 0.5
+                 and disabled_ms - traced_ms < 0.25)
+                if tiny
+                else (flight_pct <= 2.0 and disabled_pct <= 2.0)
+            )
+        ),
     }
     print(
         f"telemetry overhead: bytes +{byte_pct:.4f}%, "
-        f"p50 {plain_ms:.3f} → {traced_ms:.3f} ms ({latency_pct:+.2f}%)",
+        f"p50 {plain_ms:.3f} → {traced_ms:.3f} ms ({latency_pct:+.2f}%); "
+        f"profiler+recorder {flight_ms:.3f} ms ({flight_pct:+.2f}%), "
+        f"disabled {disabled_ms:.3f} ms ({disabled_pct:+.2f}%)",
         file=sys.stderr,
     )
     return out

@@ -41,6 +41,12 @@ class NetworkContext:
         self.users = UserManager(self.db, secret_key=self.secret_key)
         #: node_id → live proxy (socket- or poll-backed)
         self.proxies: dict[str, NodeProxy] = {}
+        # heartbeat-RTT burn-rate SLO, grouped per node — the monitor
+        # marks nodes *degraded* (alive but eating latency budget) from
+        # this engine's state, beyond the reference's alive/dead binary
+        from pygrid_tpu.telemetry.slo import SLOEngine, network_objectives
+
+        self.slo = SLOEngine(network_objectives())
 
     def proxy(self, node_id: str, address: str) -> NodeProxy:
         if node_id not in self.proxies:

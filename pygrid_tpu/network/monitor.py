@@ -22,6 +22,9 @@ logger = logging.getLogger(__name__)
 
 PING_THRESHOLD_MS = 5000.0  # reference WORKER_PROPERTIES.PING_THRESHOLD
 ONLINE, BUSY, OFFLINE = "online", "busy", "offline"
+#: alive, but burning its heartbeat-latency budget (telemetry/slo.py) —
+#: the state between "fine" and "dead" the reference cannot express
+DEGRADED = "degraded"
 
 
 class NodeProxy:
@@ -41,14 +44,19 @@ class NodeProxy:
         #: answer) — no egress dependency
         self.location: str | None = None
         self._monitor_sent_at: float | None = None
+        #: set by the monitor sweep from the network SLO engine's
+        #: per-node heartbeat burn state (monitor_loop)
+        self.degraded: bool = False
 
     @property
     def status(self) -> str:
         if self.ping is None:
             return OFFLINE
-        if self.ping < PING_THRESHOLD_MS:
-            return ONLINE
-        return BUSY
+        if self.ping >= PING_THRESHOLD_MS:
+            return BUSY
+        if self.degraded:
+            return DEGRADED
+        return ONLINE
 
     def mark_offline(self) -> None:
         self.ping = None
@@ -143,6 +151,25 @@ async def monitor_loop(ctx) -> None:
                         proxy.mark_offline()
                 else:
                     await poll_node(proxy)
+            mark_degraded(ctx)
         except Exception:  # noqa: BLE001 — keep the loop alive
             logger.exception("monitor sweep failed")
         await asyncio.sleep(ctx.monitor_interval)
+
+
+def mark_degraded(ctx) -> None:
+    """Fold the SLO engine's per-node heartbeat burn state into proxy
+    status: burn > 1 means the node is answering, but slower than its
+    latency budget sustains — degraded, not dead. Sweeps also snapshot
+    the engine so the burn windows have data at monitor cadence. The
+    verdict needs MIN_EVENTS heartbeats in the window: one slow first
+    poll from a freshly joined node is not a degradation."""
+    from pygrid_tpu.telemetry.slo import MIN_EVENTS
+
+    slo = getattr(ctx, "slo", None)
+    if slo is None:
+        return
+    slo.tick()
+    burn = slo.group_burn("heartbeat_rtt", min_events=MIN_EVENTS)
+    for node_id, proxy in ctx.proxies.items():
+        proxy.degraded = burn.get(node_id, 0.0) > 1.0

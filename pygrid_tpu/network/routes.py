@@ -96,12 +96,14 @@ async def metrics(request: web.Request) -> web.Response:
     by_status: dict[str, int] = {}
     for proxy in ctx.proxies.values():
         by_status[proxy.status] = by_status.get(proxy.status, 0) + 1
-    for status in ("online", "busy", "offline"):
+    for status in ("online", "degraded", "busy", "offline"):
         exp.gauge("grid_nodes", by_status.get(status, 0),
                   "nodes by monitor status", {"status": status})
     # the telemetry bus: request latency by route, heartbeat RTT by
     # transport, monitor poll outcomes, event counters
     telemetry.export(exp)
+    # heartbeat SLO compliance/burn gauges (telemetry/slo.py)
+    ctx.slo.export(exp)
     return web.Response(
         text=exp.render(), content_type="text/plain", charset="utf-8"
     )
@@ -284,6 +286,37 @@ async def datasets(request: web.Request) -> web.Response:
     )
 
 
+async def telemetry_slo(request: web.Request) -> web.Response:
+    """The network's burn-rate SLO view (heartbeat RTT, per-node burn
+    under ``by_node``) — twin of the node's route, same payload shape."""
+    return web.json_response({"slo": _ctx(request).slo.evaluate()})
+
+
+async def healthz(request: web.Request) -> web.Response:
+    """Shallow 200 for LB probes; ``?deep=1`` answers 503 when the
+    heartbeat SLO is in breach or a majority of nodes are unreachable."""
+    if request.query.get("deep") not in ("1", "true", "yes"):
+        return web.json_response({"status": "ok"})
+    ctx = _ctx(request)
+    rows = ctx.slo.evaluate()
+    breaches = [r["name"] for r in rows if r["status"] == "breach"]
+    proxies = list(ctx.proxies.values())
+    offline = [p.id for p in proxies if p.status == "offline"]
+    unhealthy = bool(breaches) or (
+        len(proxies) > 0 and len(offline) > len(proxies) / 2
+    )
+    return web.json_response(
+        {
+            "status": "breach" if unhealthy else "ok",
+            "breaches": breaches,
+            "nodes_offline": offline,
+            "nodes_total": len(proxies),
+            "slo": rows,
+        },
+        status=503 if unhealthy else 200,
+    )
+
+
 async def nodes_status(request: web.Request) -> web.Response:
     ctx = _ctx(request)
     return web.json_response(
@@ -325,6 +358,8 @@ def register(app: web.Application) -> None:
     r.add_get("/models", models)
     r.add_get("/datasets", datasets)
     r.add_get("/nodes-status", nodes_status)
+    r.add_get("/telemetry/slo", telemetry_slo)
+    r.add_get("/healthz", healthz)
     r.add_post("/users/signup", _rbac_twin(USER_EVENTS.SIGNUP_USER))
     r.add_post("/users/login", _rbac_twin(USER_EVENTS.LOGIN_USER))
     r.add_get("/users/", _rbac_twin(USER_EVENTS.GET_ALL_USERS))

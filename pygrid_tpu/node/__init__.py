@@ -96,6 +96,11 @@ class NodeContext:
                 max_queue=int(os.environ.get("PYGRID_SERVING_QUEUE", "64")),
             )
         )
+        # burn-rate SLOs over the bus histograms (telemetry/slo.py):
+        # GET /telemetry/slo, the deep /healthz, and the dashboard table
+        from pygrid_tpu.telemetry.slo import SLOEngine, node_objectives
+
+        self.slo = SLOEngine(node_objectives())
 
     def all_stores(self):
         """The node's singleton store plus every live session worker's store —
@@ -147,6 +152,53 @@ def create_app(
         app["node"].serving.close()
 
     app.on_cleanup.append(_close_serving)
+
+    async def _start_observability(app):
+        import asyncio
+        import logging
+
+        from pygrid_tpu.telemetry.bus import env_float
+
+        # device-memory gauges sample on their own daemon thread;
+        # the SLO engine snapshots on an asyncio cadence so burn-rate
+        # windows have data even when no one scrapes. Clamped: 0 or a
+        # negative knob would make the tick task a hot loop.
+        telemetry.profiler.MEMORY.start()
+        interval = max(1.0, env_float("PYGRID_SLO_INTERVAL_S", 15.0))
+
+        async def _tick():
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    app["node"].slo.tick()
+                except Exception:  # noqa: BLE001 — cadence must survive
+                    logging.getLogger(__name__).exception(
+                        "SLO tick failed"
+                    )
+
+        app["slo_task"] = asyncio.get_running_loop().create_task(_tick())
+
+    async def _stop_observability(app):
+        import asyncio
+        import contextlib
+
+        task = app.get("slo_task")
+        if task:
+            task.cancel()
+            # suppress the cancellation AND any stored exception: either
+            # re-raising out of an on_cleanup hook would cancel the whole
+            # app cleanup and skip the sampler release below
+            # (CancelledError is a BaseException, not an Exception)
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await task
+        # the sampler stop() joins its thread (possibly mid-sample) —
+        # a blocking wait that must not run on the event loop
+        await asyncio.get_running_loop().run_in_executor(
+            None, telemetry.profiler.MEMORY.stop
+        )
+
+    app.on_startup.append(_start_observability)
+    app.on_cleanup.append(_stop_observability)
     app.router.add_get("/", ws_handler)  # WS upgrade or landing JSON
     R.register(app)
     return app

@@ -51,6 +51,11 @@ PAGE = """<!doctype html>
 <tr><th>model</th><th>queue</th><th>slots live</th><th>requests</th>
 <th>tokens</th><th>compiles</th></tr>
 </thead><tbody></tbody></table>
+<h2>SLOs</h2>
+<table id="slo"><thead>
+<tr><th>objective</th><th>target</th><th>events</th><th>compliance</th>
+<th>burn (short)</th><th>burn (long)</th><th>status</th></tr>
+</thead><tbody></tbody></table>
 <script>
 function row(fields) {{
   const tr = document.createElement('tr');
@@ -132,6 +137,25 @@ async function refresh() {{
         e.model_id, e.queue_depth,
         e.live_slots + '/' + e.max_slots,
         e.requests_total, e.tokens_total, e.compiles_total]));
+    }}
+    const slo = await (await fetch('/telemetry/slo')).json();
+    const sloBody = document.querySelector('#slo tbody');
+    sloBody.replaceChildren();
+    const objectives = slo.slo || [];
+    if (!objectives.length) {{
+      const tr = document.createElement('tr');
+      const td = document.createElement('td');
+      td.colSpan = 7; td.className = 'muted'; td.textContent = 'none';
+      tr.appendChild(td); sloBody.appendChild(tr);
+    }}
+    for (const o of objectives) {{
+      const burns = Object.values(o.burn || {{}});
+      const fmt = (v) => v === null || v === undefined
+        ? '—' : Number(v).toFixed(2);
+      sloBody.appendChild(row([
+        o.name, o.target, o.events,
+        o.compliance === null ? '—' : (o.compliance * 100).toFixed(1) + '%',
+        fmt(burns[0]), fmt(burns[1]), o.status]));
     }}
   }} catch (err) {{
     document.getElementById('status').textContent = 'error: ' + err;

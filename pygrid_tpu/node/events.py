@@ -856,6 +856,37 @@ def _handler_of(ctx: NodeContext) -> SocketHandler:
     return _socket_handlers.setdefault(id(ctx), SocketHandler())
 
 
+def _record_handler_failure(ctx: NodeContext, event: str, err: Exception):
+    """An exception that LEAKED past a handler (the typed validation
+    paths return error dicts and never reach here) is a defect worth a
+    postmortem: note it on the flight-recorder ring and trigger a
+    rate-limited crash dump on a side thread — the dispatch path pays
+    one dict append, never file I/O. Best-effort by contract: the
+    boundary's promise is the typed error dict, and a recorder failure
+    (thread exhaustion during the very storm this exists for) must not
+    replace the exception being reported."""
+    if not telemetry.recorder.enabled():
+        return
+    try:
+        telemetry.recorder.note(
+            "handler.exception",
+            event=event,
+            error=str(err),
+            error_type=type(err).__name__,
+        )
+        # rate-limit check FIRST: during a storm, everything past this
+        # line (engine-lock snapshot, redaction, a writer thread) runs
+        # at most once per interval, not once per exception
+        if telemetry.recorder.should_dump("handler_exception"):
+            telemetry.recorder.dump_soon(
+                "handler_exception",
+                snapshot={"event": event, "serving": ctx.serving.stats()},
+                error=err,
+            )
+    except Exception:  # noqa: BLE001 — telemetry must not mask the error
+        logger.exception("flight-recorder capture failed")
+
+
 def _incoming_trace(conn: Connection, parsed: Any):
     """The message's trace context: the wire-v2 frame header (one-shot,
     set by the WS endpoint) wins; legacy framing carries a ``trace``
@@ -920,6 +951,7 @@ def route_requests(
                     try:
                         return ROUTES[event](ctx, parsed, conn)
                     except Exception as err:  # noqa: BLE001 — protocol boundary
+                        _record_handler_failure(ctx, event, err)
                         return {ERROR: str(err)}
 
                 response = _traced_call(conn, parsed, event, _dispatch)
@@ -946,6 +978,7 @@ def route_requests(
             try:
                 return handler(ctx, parsed, conn)
             except Exception as err:  # noqa: BLE001 — protocol boundary
+                _record_handler_failure(ctx, event, err)
                 return {ERROR: str(err)}
 
         response = _traced_call(conn, parsed, event, _dispatch_json)

@@ -527,6 +527,10 @@ async def metrics(request: web.Request) -> web.Response:
     # (request latency by route, frame decode time, report latency,
     # cycle phases, wire bytes by codec, serde tensor copies)
     telemetry.export(exp)
+    # device-memory gauges (background-sampled; absent on CPU backends)
+    # and the SLO compliance/burn gauges
+    telemetry.profiler.export_device_memory(exp)
+    ctx.slo.export(exp)
     return web.Response(
         text=exp.render(), content_type="text/plain", charset="utf-8"
     )
@@ -670,6 +674,67 @@ async def telemetry_serving(request: web.Request) -> web.Response:
     return web.json_response({"engines": _ctx(request).serving.stats()})
 
 
+async def telemetry_programs(request: web.Request) -> web.Response:
+    """Compile-cache introspection: every jitted serving program's key,
+    bucket, compile ms, and hit count (telemetry/profiler.py) plus the
+    latest device-memory sample — the "compile vs execute vs host"
+    attribution surface for BENCH regressions."""
+    return web.json_response(
+        {
+            "programs": telemetry.profiler.programs_snapshot(),
+            "device_memory": telemetry.profiler.MEMORY.latest(),
+            "device_memory_age_s": telemetry.profiler.MEMORY.age_s(),
+            "profiler_enabled": telemetry.profiler.enabled(),
+        }
+    )
+
+
+async def telemetry_slo(request: web.Request) -> web.Response:
+    """Burn-rate SLO evaluation (telemetry/slo.py): per objective the
+    compliance, per-window burn rates, and ok/warn/breach status — the
+    dashboard SLO table and any alerting glue poll this."""
+    return web.json_response({"slo": _ctx(request).slo.evaluate()})
+
+
+async def telemetry_dump(request: web.Request) -> web.Response:
+    """Operator-triggered flight-recorder crash dump: writes the
+    redacted JSON black box (ring + bus events + engine snapshots) and
+    returns its path. Session-token gated (a dump is work + disk, and
+    crash evidence must not be evictable by anonymous callers); always
+    writes once authorized (bypasses the per-reason rate limit); the
+    file write runs off the event loop."""
+    ctx = _ctx(request)
+    try:
+        _dc_session(request)
+    except Exception as err:  # noqa: BLE001 — HTTP boundary
+        return _json_error(err, _status_for(err))
+    path = await _off_loop(
+        lambda: telemetry.recorder.dump(
+            "operator", snapshot={"serving": ctx.serving.stats()},
+            force=True,
+        )
+    )
+    return web.json_response({"success": True, "path": path})
+
+
+async def healthz(request: web.Request) -> web.Response:
+    """Shallow by default (the process answers → 200, for LB probes);
+    ``?deep=1`` evaluates the SLO engine and serving state and answers
+    503 when any objective is in breach — the page-someone signal."""
+    if request.query.get("deep") not in ("1", "true", "yes"):
+        return web.json_response({"status": "ok"})
+    ctx = _ctx(request)
+    rows = ctx.slo.evaluate()
+    breaches = [r["name"] for r in rows if r["status"] == "breach"]
+    body = {
+        "status": "breach" if breaches else "ok",
+        "breaches": breaches,
+        "slo": rows,
+        "serving": ctx.serving.stats(),
+    }
+    return web.json_response(body, status=503 if breaches else 200)
+
+
 async def dc_dataset_tags(request: web.Request) -> web.Response:
     """(reference routes.py:171-189) all tags across the node's store."""
     ctx = _ctx(request)
@@ -792,6 +857,10 @@ def register(app: web.Application) -> None:
     r.add_get("/telemetry/cycles/{id}", telemetry_cycle_detail)
     r.add_get("/telemetry/events", telemetry_events)
     r.add_get("/telemetry/serving", telemetry_serving)
+    r.add_get("/telemetry/programs", telemetry_programs)
+    r.add_get("/telemetry/slo", telemetry_slo)
+    r.add_post("/telemetry/dump", telemetry_dump)
+    r.add_get("/healthz", healthz)
     r.add_post("/data-centric/run-generation", dc_run_generation)
     r.add_get("/data-centric/status/", dc_status)
     r.add_get("/data-centric/workers/", dc_workers)

@@ -45,6 +45,14 @@ class ServingManager:
         self.config = config or EngineConfig()
         self._engines: dict[str, tuple[Any, GenerationEngine]] = {}
         self._lock = threading.Lock()
+        # every flight-recorder crash dump carries the live engine
+        # snapshots (weakref'd: a closed app's manager must not be
+        # pinned by the process-wide recorder)
+        from pygrid_tpu import telemetry
+
+        telemetry.recorder.register_stats_provider(
+            f"serving-{id(self):x}", self
+        )
 
     def engine_for(self, model_id: str, hosted) -> GenerationEngine:
         """The live engine for ``hosted`` (building/rebuilding outside

@@ -102,9 +102,13 @@ class _Row:
 
 class _Pending:
     """One client request: B rows + the future their reassembled
-    [B, n_new] tokens resolve."""
+    [B, n_new] tokens resolve. ``request_id`` names the request in
+    engine snapshots and flight-recorder crash dumps."""
 
     def __init__(self, batch: int, n_new: int) -> None:
+        import uuid
+
+        self.request_id = uuid.uuid4().hex[:16]
         self.future: Future = Future()
         self.tokens = np.zeros((batch, n_new), np.int32)
         self.remaining = batch
@@ -140,6 +144,7 @@ class GenerationEngine:
             cfg,
             compute_dtype=self.config.compute_dtype,
             cache_dtype=self.config.cache_dtype,
+            model_id=model_id,
         )
         self._prompt_buckets = prompt_buckets(
             cfg.max_len, self.config.min_prompt_bucket
@@ -261,9 +266,27 @@ class GenerationEngine:
             ) from None
 
     def stats(self) -> dict:
-        """Live gauges for /metrics, /telemetry/serving and the
-        dashboard."""
+        """Live gauges for /metrics, /telemetry/serving, the dashboard,
+        and the flight recorder: aggregate depth/occupancy plus per-slot
+        row positions (request id, tokens emitted of n_new) so a crash
+        dump names exactly which requests were where."""
         with self._lock:
+            slots = [
+                {
+                    "slot": i,
+                    "request_id": r.pending.request_id,
+                    "row": r.row,
+                    "position": len(r.out),
+                    "n_new": r.n_new,
+                    "prompt_len": len(r.prompt),
+                }
+                for i, r in enumerate(self._slots)
+                if r is not None
+            ]
+            # dedup preserving order: a batch's rows share one request
+            queued = list(
+                dict.fromkeys(r.pending.request_id for r in self._queue)
+            )
             return {
                 "model_id": self.model_id,
                 "queue_depth": len(self._queue),
@@ -272,6 +295,8 @@ class GenerationEngine:
                 "requests_total": self._requests,
                 "tokens_total": self._tokens_out,
                 "compiles_total": self.programs.compile_count(),
+                "slots": slots,
+                "queued_requests": queued,
             }
 
     def compile_count(self) -> int:
@@ -484,9 +509,14 @@ class GenerationEngine:
 
     def _fail_all(self, err: Exception, reset_cache: bool = True) -> None:
         cache = None
+        snapshot = None
         if reset_cache:
             from pygrid_tpu.models import decode
 
+            # a failure path, not a clean close: capture the engine's
+            # last state for the flight recorder BEFORE the slots are
+            # wiped (the dump is the only record of who was in flight)
+            snapshot = self.stats()
             # the failed program may have CONSUMED the donated cache
             # buffers before raising — reallocate so the engine serves
             # the next request instead of failing forever on deleted
@@ -502,10 +532,10 @@ class GenerationEngine:
             self._live = 0
             if cache is not None:
                 self._k, self._v, self._pos = cache.k, cache.v, cache.pos
-        failed = set()
+        failed: dict[int, str] = {}
         for row in rows:
             if id(row.pending) not in failed:
-                failed.add(id(row.pending))
+                failed[id(row.pending)] = row.pending.request_id
                 if not row.pending.future.done():
                     row.pending.future.set_exception(err)
         if failed:
@@ -513,6 +543,22 @@ class GenerationEngine:
                 "serving_requests_total", len(failed), outcome="error",
                 model=self.model_id,
             )
+        if snapshot is not None:
+            snapshot["failed_request_ids"] = sorted(failed.values())
+            try:
+                telemetry.recorder.note(
+                    "engine.fail_all", model=self.model_id, error=str(err),
+                    failed=len(failed),
+                )
+                # the engine thread may write the dump synchronously: it
+                # is already off every request path (all futures failed
+                # above) — but a recorder failure (unwritable flight
+                # dir, full disk) must not kill the worker thread too
+                telemetry.recorder.dump(
+                    "engine_fail_all", snapshot=snapshot, error=err,
+                )
+            except Exception:  # noqa: BLE001 — capture is best-effort
+                logger.exception("flight-recorder capture failed")
 
     # ── helpers ─────────────────────────────────────────────────────────
 

@@ -59,10 +59,12 @@ class ProgramSet:
         cfg,
         compute_dtype: Any | None = None,
         cache_dtype: Any | None = None,
+        model_id: str = "",
     ) -> None:
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.cache_dtype = cache_dtype
+        self.model_id = model_id
         self._prefill: dict[int, Callable] = {}
         self._decode: dict[int, Callable] = {}
         self._compiles = 0
@@ -120,7 +122,10 @@ class ProgramSet:
                 tok = self._pick(logits, temp, key)
                 return tok, cache.k, cache.v, cache.pos
 
-            fn = jax.jit(_prefill, donate_argnums=(1, 2, 3))
+            fn = telemetry.profiler.wrap(
+                jax.jit(_prefill, donate_argnums=(1, 2, 3)),
+                kind="prefill", bucket=bucket, model_id=self.model_id,
+            )
             self._prefill[bucket] = fn
             self._count("prefill")
         return fn
@@ -146,7 +151,10 @@ class ProgramSet:
                 toks = jax.vmap(self._pick)(logits, temps, keys)
                 return toks, cache.k, cache.v, cache.pos
 
-            fn = jax.jit(_decode_step, donate_argnums=(1, 2, 3))
+            fn = telemetry.profiler.wrap(
+                jax.jit(_decode_step, donate_argnums=(1, 2, 3)),
+                kind="decode", bucket=width, model_id=self.model_id,
+            )
             self._decode[width] = fn
             self._count("decode")
         return fn

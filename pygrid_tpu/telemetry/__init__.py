@@ -17,6 +17,14 @@ through:
 - :mod:`pygrid_tpu.telemetry.promtext` — a strict Prometheus
   text-format parser used by the scrape-validity tests (and handy for
   ops tooling).
+- :mod:`pygrid_tpu.telemetry.profiler` — per-jit-callsite
+  compile/execute timing (``GET /telemetry/programs``) and background
+  device-memory gauges; off-switch ``PYGRID_PROFILER=off``.
+- :mod:`pygrid_tpu.telemetry.recorder` — the flight recorder: a
+  bounded ring of notable moments and redacted JSON crash dumps on
+  engine failure / unhandled handler exceptions / operator request.
+- :mod:`pygrid_tpu.telemetry.slo` — declarative burn-rate SLOs over
+  the bus histograms (``GET /telemetry/slo``, the deep ``/healthz``).
 
 Everything here must stay cheap enough to be ON by default: the hot
 loop's budget is < 2% over the bare wire path
@@ -25,7 +33,13 @@ loop's budget is < 2% over the bare wire path
 
 from __future__ import annotations
 
-from pygrid_tpu.telemetry import timeline, trace  # noqa: F401
+from pygrid_tpu.telemetry import (  # noqa: F401
+    profiler,
+    recorder,
+    slo,
+    timeline,
+    trace,
+)
 from pygrid_tpu.telemetry.bus import (  # noqa: F401
     BUS,
     Histogram,

@@ -27,6 +27,24 @@ from typing import Any, Iterable
 #: structured events kept in memory (oldest evicted first)
 RING_SIZE = 4096
 
+#: distinct label sets allowed per counter/histogram family before new
+#: sets fold into ``{other="true"}`` — a misbehaving client cycling
+#: label values (model ids, event names) must not grow /metrics without
+#: bound; every fold increments ``telemetry_labels_dropped_total``
+MAX_LABELSETS = 64
+
+#: per-family cap overrides: families whose label values legitimately
+#: scale with GRID SIZE (one series per node) get a higher ceiling —
+#: folding node #65's heartbeat into ``other`` would silently disable
+#: the per-node SLO grouping and the monitor's degraded detection
+FAMILY_MAX_LABELSETS: dict[str, int] = {
+    "heartbeat_rtt_seconds": 1024,
+    "monitor_polls_total": 1024,
+}
+
+#: the fold target for over-cardinality label sets
+_OTHER_KEY = (("other", "true"),)
+
 
 def log_linear_bounds(
     lo_exp: int = -6,
@@ -102,6 +120,13 @@ _FAMILY_HELP: dict[str, str] = {
     "serving_prefill_seconds": "per-request slot prefill (admission) time",
     "serving_queue_wait_seconds": "generation queue wait before a slot",
     "serving_batch_occupancy": "live slots per decode step",
+    # observability engine (telemetry/{profiler,recorder,slo}.py)
+    "profiler_compile_seconds": "jitted-program calls that compiled, by kind",
+    "profiler_execute_seconds": "jitted-program steady-state calls, by kind",
+    "flightrecorder_dumps_total": "flight-recorder crash dumps, by reason",
+    "telemetry_labels_dropped_total": (
+        "label sets folded into {other} by the cardinality guard, by family"
+    ),
 }
 
 
@@ -109,12 +134,52 @@ def family_help(name: str) -> str:
     return _FAMILY_HELP.get(name, f"pygrid telemetry metric {name}")
 
 
+def env_float(name: str, default: float) -> float:
+    """Env knob parse shared by the observability modules: a typo'd
+    value falls back to the default instead of raising — a knob must
+    never brick an import or an app startup."""
+    import os
+
+    try:
+        return float(os.environ[name])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
 class TelemetryBus:
-    def __init__(self, ring_size: int = RING_SIZE) -> None:
+    def __init__(
+        self,
+        ring_size: int = RING_SIZE,
+        max_labelsets: int = MAX_LABELSETS,
+    ) -> None:
         self._lock = threading.Lock()
         self._events: deque[dict] = deque(maxlen=ring_size)
         self._counters: dict[tuple[str, tuple], float] = {}
         self._histograms: dict[tuple[str, tuple], Histogram] = {}
+        self._max_labelsets = max_labelsets
+        #: family name -> distinct label sets admitted so far
+        self._labelsets: dict[str, int] = {}
+
+    def _admit(
+        self, name: str, labels_key: tuple, existing: dict
+    ) -> tuple[str, tuple]:
+        """Under the lock: the storage key for one sample. A family at
+        its cardinality cap folds NEW label sets into ``{other="true"}``
+        (and counts the fold) instead of growing /metrics forever;
+        existing series and unlabeled samples always pass."""
+        key = (name, labels_key)
+        if not labels_key or key in existing:
+            return key
+        admitted = self._labelsets.get(name, 0)
+        cap = FAMILY_MAX_LABELSETS.get(name, self._max_labelsets)
+        if admitted >= cap:
+            dropped = (
+                "telemetry_labels_dropped_total", (("family", name),)
+            )
+            self._counters[dropped] = self._counters.get(dropped, 0) + 1
+            return (name, _OTHER_KEY)
+        self._labelsets[name] = admitted + 1
+        return key
 
     # ── producers (the hot-path surface) ────────────────────────────────
 
@@ -123,14 +188,16 @@ class TelemetryBus:
         ``event`` is positional-only so fields named ``event`` cannot
         collide; the name key still wins in the stored entry."""
         entry = {**fields, "event": event, "ts": time.time()}
-        key = ("events_total", (("event", event),))
         with self._lock:
             self._events.append(entry)
+            key = self._admit(
+                "events_total", (("event", event),), self._counters
+            )
             self._counters[key] = self._counters.get(key, 0) + 1
 
     def incr(self, name: str, value: float = 1, **labels: Any) -> None:
-        key = (name, _label_key(labels))
         with self._lock:
+            key = self._admit(name, _label_key(labels), self._counters)
             self._counters[key] = self._counters.get(key, 0) + value
 
     def observe(
@@ -140,8 +207,8 @@ class TelemetryBus:
         bounds: Iterable[float] | None = None,
         **labels: Any,
     ) -> None:
-        key = (name, _label_key(labels))
         with self._lock:
+            key = self._admit(name, _label_key(labels), self._histograms)
             hist = self._histograms.get(key)
             if hist is None:
                 hist = self._histograms[key] = Histogram(bounds)
@@ -173,6 +240,7 @@ class TelemetryBus:
             self._events.clear()
             self._counters.clear()
             self._histograms.clear()
+            self._labelsets.clear()
 
 
 #: the process-wide bus — module functions below are its bound methods,

@@ -1,0 +1,176 @@
+"""The observability engine over a real grid: compile-cache
+introspection, burn-rate SLOs, deep-vs-shallow health, operator crash
+dumps, and the strict Prometheus parse gating every new family on both
+apps' ``/metrics``."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from pygrid_tpu.client import DataCentricFLClient
+from pygrid_tpu.models import decode
+from pygrid_tpu.models import transformer as T
+from pygrid_tpu.telemetry import promtext
+
+CFG = T.TransformerConfig(
+    vocab=29, d_model=16, n_heads=2, n_layers=1, d_ff=32, max_len=32
+)
+MODEL_ID = "obs-grid"
+
+
+@pytest.fixture(scope="module")
+def generated(grid):
+    """Host a tiny bundle on charlie and run one generation, so the
+    profiler has programs and the TTFT histogram has samples."""
+    params = T.init(jax.random.PRNGKey(23), CFG)
+    client = DataCentricFLClient(grid.node_url("charlie"))
+    out = client.serve_model(
+        decode.bundle(CFG, params), MODEL_ID, allow_remote_inference=True
+    )
+    assert out.get("success"), out
+    tokens = client.run_remote_generation(
+        MODEL_ID, np.array([[3, 1, 4]]), n_new=4
+    )
+    client.close()
+    assert np.asarray(tokens).shape == (1, 4)
+    return grid.node_url("charlie")
+
+
+def test_telemetry_programs_names_compiled_programs(generated):
+    body = requests.get(generated + "/telemetry/programs", timeout=10).json()
+    assert body["profiler_enabled"] is True
+    programs = body["programs"]
+    mine = [p for p in programs if p["model"] == MODEL_ID]
+    kinds = {p["kind"] for p in mine}
+    assert {"prefill", "decode"} <= kinds, programs
+    for p in mine:
+        assert p["program"] == f"{p['kind']}/{p['bucket']}"
+        assert p["compiles"] >= 1
+        assert p["compile_ms"] > 0
+    # the decode loop ran more than it compiled: steady-state hits
+    decode_rows = [p for p in mine if p["kind"] == "decode"]
+    assert sum(p["hits"] for p in decode_rows) >= 1
+    assert isinstance(body["device_memory"], list)
+
+
+def test_telemetry_slo_rows_and_deep_healthz_agree(generated):
+    rows = requests.get(generated + "/telemetry/slo", timeout=10).json()["slo"]
+    by_name = {r["name"]: r for r in rows}
+    assert {"serving_ttft", "report_handler", "cycle_round"} <= set(by_name)
+    ttft = by_name["serving_ttft"]
+    assert ttft["events"] >= 1  # the generation above observed TTFT
+    for r in rows:
+        assert r["status"] in ("ok", "warn", "breach", "no_data")
+        assert set(r["burn"]) == {"5m", "1h"}
+    # deep health tells the same story the SLO rows do (on CPU the
+    # first-request compile can blow the TTFT threshold — the contract
+    # under test is coherence, not this box's speed)
+    deep = requests.get(generated + "/healthz?deep=1", timeout=10)
+    body = deep.json()
+    breaching = [r["name"] for r in body["slo"] if r["status"] == "breach"]
+    assert body["breaches"] == breaching
+    assert (deep.status_code == 503) == bool(breaching)
+    assert body["status"] == ("breach" if breaching else "ok")
+
+
+def test_shallow_healthz_is_always_200(grid):
+    for url in [grid.node_url("alice"), grid.network_url]:
+        got = requests.get(url + "/healthz", timeout=10)
+        assert got.status_code == 200
+        assert got.json() == {"status": "ok"}
+
+
+def _operator_token(grid, name="charlie"):
+    """The dump route is session-gated; mint a token from the seeded
+    admin like the other HTTP-route tests do."""
+    _session, tok = grid.nodes[name].app["node"].sessions.login(
+        "admin", "admin"
+    )
+    return tok
+
+
+def test_operator_dump_route_writes_redacted_json(
+    generated, grid, tmp_path, monkeypatch
+):
+    monkeypatch.setenv("PYGRID_FLIGHT_DIR", str(tmp_path))
+    # anonymous callers must not be able to burn disk / evict evidence
+    denied = requests.post(generated + "/telemetry/dump", timeout=10)
+    assert denied.status_code == 400
+    got = requests.post(
+        generated + "/telemetry/dump",
+        headers={"token": _operator_token(grid)},
+        timeout=10,
+    ).json()
+    assert got["success"] and got["path"]
+    data = json.loads(open(got["path"], encoding="utf-8").read())
+    assert data["reason"] == "operator"
+    # the dump carries the serving snapshot the route attached
+    assert "serving" in data["snapshot"]
+    assert os.path.dirname(got["path"]) == str(tmp_path)
+
+
+def test_network_heartbeat_slo_appears_with_per_node_burn(grid):
+    deadline = time.monotonic() + 20
+    by_node = {}
+    while time.monotonic() < deadline:
+        rows = requests.get(
+            grid.network_url + "/telemetry/slo", timeout=10
+        ).json()["slo"]
+        hb = next(r for r in rows if r["name"] == "heartbeat_rtt")
+        if hb["events"] >= 1:
+            by_node = hb.get("by_node", {})
+            break
+        time.sleep(0.3)  # the 0.3 s monitor sweep hasn't landed yet
+    else:
+        pytest.fail("no heartbeat observations after 20s of monitoring")
+    # localhost heartbeats are fast: nobody should be burning budget
+    assert all(burn <= 1.0 for burn in by_node.values()), by_node
+    # and the monitor marked nobody degraded
+    statuses = requests.get(
+        grid.network_url + "/nodes-status", timeout=10
+    ).json()
+    assert all(v["status"] != "degraded" for v in statuses.values())
+    deep = requests.get(grid.network_url + "/healthz?deep=1", timeout=10)
+    assert deep.status_code == 200, deep.json()
+
+
+def test_new_families_pass_strict_parse_on_both_metrics(generated, grid):
+    # a dump guarantees flightrecorder_dumps_total exists process-wide
+    requests.post(
+        generated + "/telemetry/dump",
+        headers={"token": _operator_token(grid)},
+        timeout=10,
+    )
+    # burn gauges need traffic BETWEEN two SLO snapshots: scrape once
+    # (which ticks the engine), then serve a generation, then re-scrape
+    requests.get(generated + "/metrics", timeout=10)
+    client = DataCentricFLClient(generated)
+    client.run_remote_generation(MODEL_ID, np.array([[2, 7]]), n_new=3)
+    client.close()
+    node_families = promtext.parse(
+        requests.get(generated + "/metrics", timeout=10).text
+    )
+    assert "pygrid_profiler_compile_seconds" in node_families
+    assert "pygrid_profiler_execute_seconds" in node_families
+    assert "pygrid_flightrecorder_dumps_total" in node_families
+    assert "pygrid_slo_compliance" in node_families
+    assert "pygrid_slo_burn_rate" in node_families
+    assert node_families["pygrid_profiler_compile_seconds"].type == "histogram"
+    assert node_families["pygrid_slo_compliance"].type == "gauge"
+    network_families = promtext.parse(
+        requests.get(grid.network_url + "/metrics", timeout=10).text
+    )
+    # the degraded state is a first-class gauge label on the network
+    nodes_by_status = {
+        s[1]["status"]: s[2]
+        for s in network_families["pygrid_grid_nodes"].samples
+    }
+    assert "degraded" in nodes_by_status

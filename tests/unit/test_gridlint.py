@@ -370,6 +370,105 @@ class TestGL3:
         """, AsyncHygieneChecker)
         assert res.failures == []
 
+    def test_one_hop_helper_call_fires_GL304(self, tmp_path):
+        res = _lint(tmp_path, """
+            import time
+            from pygrid_tpu.serde import serialize
+
+            def decode_body(model):
+                time.sleep(0.1)
+                return serialize(model)
+
+            class Routes:
+                def _validate(self, x):
+                    return self._q.get()
+
+                async def handler(self, request, model):
+                    self._validate(model)       # method one hop
+                    return decode_body(model)   # module helper one hop
+        """, AsyncHygieneChecker)
+        codes = _codes(res)
+        assert codes == ["GL304", "GL304", "GL304"]
+        messages = " ".join(f.message for f in res.failures)
+        assert "decode_body" in messages and "_validate" in messages
+        assert "handler" in messages
+
+    def test_one_hop_helper_referenced_not_called_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import asyncio
+            import json
+            from pygrid_tpu.serde import serialize
+
+            def heavy(model):
+                return serialize(model)
+
+            async def _off_loop(fn, *args):
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(None, fn, *args)
+
+            async def handler(request, model):
+                # handed to the executor, never CALLED on the loop
+                return await _off_loop(heavy, model)
+
+            async def clean(request):
+                return json.dumps({"ok": True})
+        """, AsyncHygieneChecker)
+        assert res.failures == []
+
+    def test_one_hop_bare_call_does_not_resolve_to_class_method(
+        self, tmp_path
+    ):
+        res = _lint(tmp_path, """
+            import time
+            from pygrid_tpu.serde import serialize
+
+            class Codec:
+                def serialize(self):
+                    # an unrelated method shadowing the imported name —
+                    # the async handler calls the IMPORT, not this
+                    time.sleep(1)
+
+            async def handler(request, model):
+                return serialize(model)
+        """, AsyncHygieneChecker)
+        # the direct call is GL303 (imported serde helper); the method's
+        # sleep must NOT surface as a bogus GL304
+        assert _codes(res) == ["GL303"]
+
+    def test_one_hop_self_call_scoped_to_own_class(self, tmp_path):
+        res = _lint(tmp_path, """
+            import time
+
+            class Blocking:
+                def _validate(self, x):
+                    time.sleep(1)
+
+            class Clean:
+                def _validate(self, x):
+                    return x
+
+                async def handler(self, request):
+                    # Clean's own _validate — Blocking's same-named
+                    # method must not misattribute a GL304 here
+                    return self._validate(request)
+        """, AsyncHygieneChecker)
+        assert res.failures == []
+
+    def test_one_hop_reports_once_for_many_callers(self, tmp_path):
+        res = _lint(tmp_path, """
+            import time
+
+            def slow():
+                time.sleep(1)
+
+            async def a(request):
+                slow()
+
+            async def b(request):
+                slow()
+        """, AsyncHygieneChecker)
+        assert _codes(res) == ["GL304"]  # one finding at the bad line
+
 
 # ── GL4 contract drift ───────────────────────────────────────────────────
 
@@ -471,6 +570,74 @@ class TestGL4:
             def serve():
                 telemetry.incr("anything_total")
         """, ContractDriftChecker)
+        assert res.failures == []
+
+    def test_undocumented_route_path_fires_GL405(self, tmp_path):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "README.md": "Endpoints: `/metrics` and `/users/<id>`.\n",
+            "docs/OBSERVABILITY.md": "Also `GET /telemetry/cycles`.\n",
+            "pkg/node/routes.py": """
+                def register(app):
+                    r = app.router
+                    r.add_get("/metrics", None)
+                    r.add_get("/telemetry/cycles", None)
+                    r.add_get("/users/{id}", None)       # <id> form in docs
+                    r.add_post("/telemetry/dump", None)  # undocumented
+                    r.add_route("*", "/speed-test", None)  # undocumented
+            """,
+        })
+        assert _codes(res) == ["GL405", "GL405"]
+        messages = " ".join(f.message for f in res.failures)
+        assert "/telemetry/dump" in messages and "/speed-test" in messages
+
+    def test_route_paths_outside_route_modules_are_ignored(self, tmp_path):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "README.md": "no routes here\n",
+            "pkg/examples/demo.py": """
+                def register(app):
+                    app.router.add_get("/undocumented-but-not-served", None)
+            """,
+        })
+        assert res.failures == []
+
+    def test_undocumented_ws_event_key_fires_GL406(self, tmp_path):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "docs/WIRE.md": "events: `socket-ping`, `model-centric/report`. 0x01\n",
+            "pkg/utils/codes.py": """
+                class EVENTS:
+                    PING = "socket-ping"
+                    REPORT = "model-centric/report"
+                    SECRET = "model-centric/undocumented"
+            """,
+            "pkg/node/events.py": """
+                from pkg.utils.codes import EVENTS
+
+                ROUTES = {
+                    EVENTS.PING: None,
+                    EVENTS.REPORT: None,
+                    EVENTS.SECRET: None,     # resolved via codes.py
+                    "bare-undocumented": None,
+                }
+            """,
+        })
+        codes = _codes(res)
+        assert codes == ["GL406", "GL406"]
+        messages = " ".join(f.message for f in res.failures)
+        assert "model-centric/undocumented" in messages
+        assert "bare-undocumented" in messages
+
+    def test_spread_and_unresolvable_routes_keys_are_skipped(self, tmp_path):
+        res = _lint(tmp_path, None, ContractDriftChecker, files={
+            "docs/WIRE.md": "nothing documented. 0x01\n",
+            "pkg/node/events.py": """
+                from elsewhere import HANDLERS, FOREIGN
+
+                ROUTES = {
+                    FOREIGN.KEY: None,   # constant not in this tree
+                    **HANDLERS,          # spread: no keys to check
+                }
+            """,
+        })
         assert res.failures == []
 
 

@@ -297,3 +297,86 @@ def test_telemetry_overhead_within_budget():
         - out["telemetry_roundtrip_ms_plain"]
     )
     assert overhead_ms < 0.5, out
+    # the profiler+recorder layer (PR-5): same absolute-bound logic —
+    # ≤ 0.25 ms per round keeps the checkpoint-scale round (~100 ms,
+    # measured ±1% ≈ noise) inside the ≤2% acceptance criterion, and
+    # the off-switched variant must be indistinguishable from no layer
+    # bounds carry the same scheduler-noise headroom as the 0.5 ms
+    # budget above: a p50-minus-p50 difference on ~50µs rounds jitters
+    # tens of µs on a loaded host; the real ≤2% gate runs at full scale
+    flight_ms = (
+        out["telemetry_roundtrip_ms_flight"]
+        - out["telemetry_roundtrip_ms_traced"]
+    )
+    assert flight_ms < 0.5, out
+    disabled_ms = (
+        out["telemetry_roundtrip_ms_flight_disabled"]
+        - out["telemetry_roundtrip_ms_traced"]
+    )
+    assert disabled_ms < 0.25, out
+
+
+# ── cardinality guard ───────────────────────────────────────────────────
+
+
+def test_counter_labelsets_fold_into_other_at_cap():
+    bus = TelemetryBus(max_labelsets=4)
+    for i in range(6):
+        bus.incr("requests_total", 1, model=f"m{i}")
+    got = bus.counters()
+    named = [
+        k for k in got
+        if k[0] == "requests_total" and k[1] != (("other", "true"),)
+    ]
+    assert len(named) == 4  # the cap
+    assert got[("requests_total", (("other", "true"),))] == 2
+    assert got[
+        ("telemetry_labels_dropped_total", (("family", "requests_total"),))
+    ] == 2
+
+
+def test_existing_series_keep_counting_past_the_cap():
+    bus = TelemetryBus(max_labelsets=2)
+    bus.incr("n", 1, k="a")
+    bus.incr("n", 1, k="b")
+    bus.incr("n", 1, k="c")      # folds
+    bus.incr("n", 5, k="a")      # admitted long ago — still lands
+    assert bus.counters()[("n", (("k", "a"),))] == 6
+
+
+def test_histogram_labelsets_fold_and_unlabeled_exempt():
+    bus = TelemetryBus(max_labelsets=2)
+    for i in range(4):
+        bus.observe("lat_seconds", 0.01, route=f"/r{i}")
+    hists = bus.histograms()
+    assert ("lat_seconds", (("other", "true"),)) in hists
+    assert hists[("lat_seconds", (("other", "true"),))]["count"] == 2
+    # unlabeled samples never fold (no cardinality to guard)
+    for _ in range(10):
+        bus.observe("plain_seconds", 0.01)
+    assert bus.histograms()[("plain_seconds", ())]["count"] == 10
+
+
+def test_grid_scale_families_get_the_higher_cap():
+    # one heartbeat series per NODE is legitimate growth — folding node
+    # #65 into {other} would silently kill its per-node SLO grouping
+    # and degraded detection, so these families carry a higher ceiling
+    bus = TelemetryBus(max_labelsets=4)
+    for i in range(80):
+        bus.observe(
+            "heartbeat_rtt_seconds", 0.01, node=f"n{i}", transport="http"
+        )
+    hists = bus.histograms()
+    assert ("heartbeat_rtt_seconds", (("other", "true"),)) not in hists
+    assert (
+        len([k for k in hists if k[0] == "heartbeat_rtt_seconds"]) == 80
+    )
+
+
+def test_event_families_are_guarded_too():
+    bus = TelemetryBus(max_labelsets=3)
+    for i in range(5):
+        bus.record(f"hostile.event.{i}")
+    got = bus.counters()
+    assert got[("events_total", (("other", "true"),))] == 2
+    assert len(bus.events()) == 5  # the ring itself is already bounded
