@@ -10,13 +10,20 @@ engine's no-recompile contract (``serving/programs.py``) also dies by a
 thousand ``jax.jit(...)(x)`` cuts: a jit built per call retraces per
 call.
 
-Detection is module-local and deliberately conservative: a function is
-*jitted* when it is decorated with ``jit``/``pjit`` (bare, dotted, or
-via ``partial(jax.jit, ...)``) or its name/lambda is passed as the
-first argument to a ``jit``/``pjit`` call anywhere in the module.
-Reachability then closes over module-level functions and same-class
-``self.``/``cls.`` methods called from a jitted body — cross-module
-calls are out of scope (see ROADMAP open items).
+Detection is deliberately conservative: a function is *jitted* when it
+is decorated with ``jit``/``pjit`` (bare, dotted, or via
+``partial(jax.jit, ...)``) or its name/lambda is passed as the first
+argument to a ``jit``/``pjit`` call anywhere in the module.
+Reachability closes over module-level functions and same-class
+``self.``/``cls.`` methods called from a jitted body, and — the
+**two-pass whole-run extension** — over CROSS-MODULE calls: every
+file's function index and import table feed a run-wide symbol table in
+``finalize``, so a jitted body in ``serving/programs.py`` calling
+``decode.step(...)`` pulls ``models/decode.py``'s ``step`` (and its
+local closure, and any further imported hops) into the trace-safety
+closure. Cross-module findings are attributed to the file that
+contains the side effect; duplicates with that module's own local
+closure are folded.
 """
 
 from __future__ import annotations
@@ -126,6 +133,51 @@ class _FunctionIndex(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _module_dotted(rel_path: str) -> str:
+    """``pygrid_tpu/models/decode.py`` → ``pygrid_tpu.models.decode``;
+    ``pkg/__init__.py`` → ``pkg``."""
+    parts = rel_path[:-3].split("/") if rel_path.endswith(".py") else (
+        rel_path.split("/")
+    )
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _ImportIndex(ast.NodeVisitor):
+    """Every import binding in one file (any scope — this repo imports
+    lazily inside function bodies): ``aliases`` maps a local name to the
+    dotted module it stands for, ``symbols`` maps a local name to
+    ``(dotted_module, original_name)`` for from-imports."""
+
+    def __init__(self, package: str) -> None:
+        self.package = package  # dotted package of the current module
+        self.aliases: dict[str, str] = {}
+        self.symbols: dict[str, tuple[str, str]] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            # ``import a.b`` binds ``a``; ``import a.b as c`` binds c→a.b
+            self.aliases[local] = (
+                alias.name if alias.asname else alias.name.split(".")[0]
+            )
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:
+            # relative import: walk up from the current package
+            parts = self.package.split(".") if self.package else []
+            parts = parts[: len(parts) - (node.level - 1)]
+            base = ".".join(parts + ([node.module] if node.module else []))
+        for alias in node.names:
+            local = alias.asname or alias.name
+            # ``from pkg import mod`` may bind a MODULE — record it both
+            # ways; resolution tries the module table first
+            self.aliases.setdefault(local, f"{base}.{alias.name}")
+            self.symbols[local] = (base, alias.name)
+
+
 class _TraceBodyScan(ast.NodeVisitor):
     """Walk one jitted body collecting side-effects and outgoing calls."""
 
@@ -195,9 +247,33 @@ class TraceSafetyChecker(Checker):
         "GL103": "jit-per-call / jit-in-loop recompile hazard",
     }
 
+    def __init__(self) -> None:
+        # per-file state feeding the whole-run (cross-module) second
+        # pass in finalize; keyed by rel_path
+        self._indexes: dict[str, _FunctionIndex] = {}
+        self._imports: dict[str, _ImportIndex] = {}
+        self._mods: dict[str, ModuleContext] = {}
+        self._roots: dict[str, list[ast.AST]] = {}
+        #: (path, line, code) already reported by the module-local pass —
+        #: the cross-module closure folds duplicates instead of double-
+        #: reporting the same effect line
+        self._reported: set[tuple[str, int, str]] = set()
+        self._dotted_to_rel: dict[str, str] = {}
+
     def check_module(self, mod: ModuleContext) -> Iterable[Finding]:
         index = _FunctionIndex()
         index.visit(mod.tree)
+        dotted = _module_dotted(mod.rel_path)
+        package = (
+            dotted
+            if mod.rel_path.endswith("__init__.py")
+            else (dotted.rsplit(".", 1)[0] if "." in dotted else "")
+        )
+        imports = _ImportIndex(package)
+        imports.visit(mod.tree)
+        self._indexes[mod.rel_path] = index
+        self._imports[mod.rel_path] = imports
+        self._mods[mod.rel_path] = mod
 
         # resolve "jit(name)" entries to def nodes where possible
         roots: list[ast.AST] = []
@@ -214,6 +290,7 @@ class TraceSafetyChecker(Checker):
                     roots.append(node)
             else:
                 roots.append(entry)
+        self._roots[mod.rel_path] = roots
 
         findings: list[Finding] = []
         scans: dict[int, _TraceBodyScan] = {}
@@ -238,8 +315,12 @@ class TraceSafetyChecker(Checker):
             seen.add(id(fn_node))
             scan = _scan(fn_node)
             for node, code, msg in scan.effects:
-                findings.append(
-                    mod.finding(code, node, f"{msg} (reachable under jax.jit)")
+                finding = mod.finding(
+                    code, node, f"{msg} (reachable under jax.jit)"
+                )
+                findings.append(finding)
+                self._reported.add(
+                    (finding.path, finding.line, finding.code)
                 )
             for callee in scan.calls:
                 short = callee.split(".")[-1]
@@ -289,4 +370,110 @@ class TraceSafetyChecker(Checker):
         jit_use.visit(mod.tree)
         for node, msg in jit_use.out:
             findings.append(mod.finding("GL103", node, msg))
+        return findings
+
+    # ── pass 2: whole-run cross-module reachability ──────────────────────
+
+    def _resolve_callee(
+        self, rel_path: str, callee: str
+    ) -> list[tuple[str, ast.AST]]:
+        """Where ``callee`` (a dotted call string seen in ``rel_path``)
+        might be defined ACROSS the run's modules. Module-local
+        resolution stays loose (the pass-1 behavior); cross-module
+        resolution requires the receiver to be an actual import binding
+        and the name to resolve in the target's function index — no
+        short-name guessing across files."""
+        out: list[tuple[str, ast.AST]] = []
+        index = self._indexes.get(rel_path)
+        imports = self._imports.get(rel_path)
+        if index is None or imports is None:
+            return out
+        short = callee.split(".")[-1]
+        for target_name, target in index.defs.items():
+            if target_name == callee or target_name.split(".")[-1] in (
+                callee, short,
+            ):
+                out.append((rel_path, target))
+        dotted_to_rel = self._dotted_to_rel
+        head, _, rest = callee.partition(".")
+        if rest:
+            # ``mod.fn(...)`` / ``mod.Class.meth(...)`` through an
+            # import binding of ``mod``
+            target_mod = imports.aliases.get(head)
+            target_rel = dotted_to_rel.get(target_mod or "")
+            if target_rel is not None:
+                target_index = self._indexes.get(target_rel)
+                if target_index is not None:
+                    node = target_index.defs.get(
+                        rest
+                    ) or target_index.defs.get(rest.split(".")[-1])
+                    if node is not None:
+                        out.append((target_rel, node))
+        else:
+            # bare ``fn(...)`` bound by ``from mod import fn [as alias]``
+            sym = imports.symbols.get(callee)
+            if sym is not None:
+                target_rel = dotted_to_rel.get(sym[0])
+                if target_rel is not None:
+                    target_index = self._indexes.get(target_rel)
+                    if target_index is not None:
+                        node = target_index.defs.get(sym[1])
+                        if node is not None:
+                            out.append((target_rel, node))
+        return out
+
+    def finalize(self, run) -> Iterable[Finding]:
+        """The two-pass symbol-table closure: re-walk every jitted root,
+        this time following calls THROUGH import bindings into other
+        scanned modules (and onward — the frontier carries the module a
+        function lives in, so its own imports resolve the next hop).
+        Effects land in the file that contains them; anything pass 1
+        already reported is folded."""
+        self._dotted_to_rel = {
+            _module_dotted(rel): rel for rel in self._indexes
+        }
+        findings: list[Finding] = []
+        scans: dict[int, _TraceBodyScan] = {}
+
+        def _scan(fn_node: ast.AST) -> _TraceBodyScan:
+            key = id(fn_node)
+            if key not in scans:
+                scan = _TraceBodyScan()
+                body = getattr(fn_node, "body", [])
+                for stmt in body if isinstance(body, list) else [body]:
+                    scan.visit(stmt)
+                scans[key] = scan
+            return scans[key]
+
+        for root_rel, roots in self._roots.items():
+            seen: set[tuple[str, int]] = set()
+            frontier: list[tuple[str, ast.AST]] = [
+                (root_rel, fn) for fn in roots
+            ]
+            while frontier:
+                fn_rel, fn_node = frontier.pop()
+                if (fn_rel, id(fn_node)) in seen:
+                    continue
+                seen.add((fn_rel, id(fn_node)))
+                scan = _scan(fn_node)
+                fn_mod = self._mods.get(fn_rel)
+                if fn_mod is not None and fn_rel != root_rel:
+                    # only FOREIGN effects are new — pass 1 owns the
+                    # root module's local closure
+                    for node, code, msg in scan.effects:
+                        finding = fn_mod.finding(
+                            code,
+                            node,
+                            f"{msg} (reachable under jax.jit via a "
+                            f"cross-module call from {root_rel})",
+                        )
+                        key = (finding.path, finding.line, finding.code)
+                        if key in self._reported:
+                            continue
+                        self._reported.add(key)
+                        findings.append(finding)
+                for callee in scan.calls:
+                    for hop in self._resolve_callee(fn_rel, callee):
+                        if (hop[0], id(hop[1])) not in seen:
+                            frontier.append(hop)
         return findings

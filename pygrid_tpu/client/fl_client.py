@@ -180,7 +180,16 @@ class FLJob:
                 self.client._residuals[res_key] = new_residual
             return response
         blob = serialize_model_params(list(diff_params), bf16=bf16)
-        return self.client.report(self.worker_id, self.request_key, blob)
+        # version rides the fold-group hint: two processes hosting
+        # different versions of one model name must never share a
+        # sub-aggregator partial sum
+        hint = self.model_name
+        if self.model_version:
+            hint = f"{hint}@{self.model_version}"
+        return self.client.report(
+            self.worker_id, self.request_key, blob,
+            model_name=hint,
+        )
 
 
 class FLClient:
@@ -205,6 +214,7 @@ class FLClient:
         timeout: float = 60.0,
         wire: str = "auto",
         codec: str | None = None,
+        aggregator_url: str | None = None,
     ) -> None:
         if wire not in ("json", "binary", "auto"):
             raise ValueError("wire must be 'json', 'binary' or 'auto'")
@@ -233,6 +243,7 @@ class FLClient:
         self.verbose = verbose
         self.wire = wire
         self.codec = codec
+        self._timeout = timeout
         # plans are immutable per id once hosted (PlanManager stores the
         # variants at host time), so refetching across cycles is pure waste
         self._plan_cache: dict[tuple[int, str], Any] = {}
@@ -245,6 +256,12 @@ class FLClient:
         from pygrid_tpu.client.ws_transport import KeepAliveHTTP
 
         self._http = KeepAliveHTTP(self.address, timeout=timeout)
+        #: sub-aggregator report routing (docs/AGGREGATION.md): when the
+        #: network's placement assigns one, reports dial it instead of
+        #: the node; any failure falls back to a direct node report —
+        #: the hierarchy is an optimization, never a correctness gate
+        self.aggregator_url = aggregator_url
+        self._agg_ws: GridWSClient | None = None
 
     def new_job(self, model_name: str, model_version: str | None = None) -> FLJob:
         return FLJob(self, model_name, model_version)
@@ -441,7 +458,23 @@ class FLClient:
         )
         return response.get(MSG_FIELD.DATA, response)
 
-    def report(self, worker_id: str, request_key: str, diff_blob: bytes) -> dict:
+    def report(
+        self,
+        worker_id: str,
+        request_key: str,
+        diff_blob: bytes,
+        model_name: str | None = None,
+    ) -> dict:
+        if self.aggregator_url:
+            response = self._report_via_aggregator(
+                worker_id, request_key, diff_blob, model_name
+            )
+            if response is not None:
+                return response
+            # sub-aggregator unreachable or refusing (killed mid-cycle,
+            # unsupported envelope): drop the assignment and report
+            # direct — the node's slot for this key is still open
+            self.aggregator_url = None
         if self._binary_framing():
             response = self._send_event(
                 MODEL_CENTRIC_FL_EVENTS.REPORT,
@@ -465,6 +498,54 @@ class FLClient:
             )
         return response.get(MSG_FIELD.DATA, response)
 
+    def _report_via_aggregator(
+        self,
+        worker_id: str,
+        request_key: str,
+        diff_blob: bytes,
+        model_name: str | None,
+    ) -> dict | None:
+        """One report through the assigned sub-aggregator; None means
+        "fall back to a direct node report" (dead or refusing
+        aggregator). The ``model`` hint keys the sub-aggregator's fold
+        group so concurrent FL processes never share a partial sum."""
+        from pygrid_tpu.utils.codes import MODEL_CENTRIC_FL_EVENTS
+
+        try:
+            if self._agg_ws is None:
+                self._agg_ws = GridWSClient(
+                    self.aggregator_url,
+                    timeout=self._timeout,
+                    offer_wire_v2=True,
+                )
+            response = self._agg_ws.send_msg_binary(
+                MODEL_CENTRIC_FL_EVENTS.REPORT,
+                data={
+                    MSG_FIELD.WORKER_ID: worker_id,
+                    CYCLE.KEY: request_key,
+                    CYCLE.DIFF: diff_blob,
+                    **({MSG_FIELD.MODEL: model_name} if model_name else {}),
+                },
+            )
+            data = response.get(MSG_FIELD.DATA, response)
+            if data.get("error"):
+                # a refusing aggregator won't be dialed again (caller
+                # clears aggregator_url) — drop the socket now rather
+                # than holding it for the client's remaining lifetime
+                self._agg_ws.close()
+                self._agg_ws = None
+                return None
+            return data
+        except Exception:  # noqa: BLE001 — fallback is the contract
+            try:
+                if self._agg_ws is not None:
+                    self._agg_ws.close()
+            finally:
+                self._agg_ws = None
+            return None
+
     def close(self) -> None:
         self.ws.close()
         self._http.close()
+        if self._agg_ws is not None:
+            self._agg_ws.close()

@@ -260,3 +260,29 @@ class FLController:
         self.cycle_manager.submit_worker_diff(
             worker_id, request_key, diff, wire_codec=wire_codec
         )
+
+    def submit_partial(
+        self,
+        entries: list[tuple[str, str]],
+        diff: bytes,
+        count: int,
+        weight_sum: float | None = None,
+        masked: bool = False,
+        wire_codec: str | None = None,
+    ) -> None:
+        """One sub-aggregator partial: a subtree's pre-folded diff sum
+        covering ``entries`` = [(worker_id, request_key), ...] — every
+        key is validated exactly like a direct report."""
+        for worker_id, request_key in entries:
+            if not request_key:
+                raise E.MissingRequestKeyError()
+            if not worker_id:
+                raise E.PyGridError("partial entry missing worker_id")
+        self.cycle_manager.submit_worker_partial(
+            entries,
+            diff,
+            count,
+            weight_sum=weight_sum,
+            masked=masked,
+            wire_codec=wire_codec,
+        )

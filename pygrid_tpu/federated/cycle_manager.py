@@ -41,6 +41,11 @@ from pygrid_tpu.utils import exceptions as E
 
 logger = logging.getLogger(__name__)
 
+#: bound-variable budget per IN-list statement — safely under
+#: SQLITE_MAX_VARIABLE_NUMBER on every SQLite build (999 historically),
+#: so a legal many-thousand-member partial cannot blow a statement
+_SQL_IN_CHUNK = 500
+
 
 class _DiffAccumulator:
     """Running per-parameter (optionally weighted) sum of a cycle's diffs
@@ -83,7 +88,9 @@ class _DiffAccumulator:
     def add_raw(self, raws: list, weight: float = 1.0) -> None:
         """Fold tensors still in wire form (``serde.RawTensor``) — the
         native one-pass accumulate; bf16 payloads fold without ever
-        materializing as float32. Caller validated kinds/shapes."""
+        materializing as float32, and f64 payloads (hierarchical partial
+        sums) view the wire buffer directly. Caller validated
+        kinds/shapes."""
         from pygrid_tpu.native import accum_bf16, accum_f32
 
         if self.sums is None:
@@ -93,10 +100,44 @@ class _DiffAccumulator:
         for s, rt in zip(self.sums, raws):
             if rt.kind == "bf16":
                 accum_bf16(s, rt.raw, weight)
+            elif rt.kind == "<f8":
+                flat = s.reshape(-1)
+                src = np.frombuffer(rt.raw, dtype=np.float64)
+                if weight == 1.0:
+                    np.add(flat, src, out=flat)
+                else:
+                    flat += src * weight
             else:
                 accum_f32(s, rt.raw, weight)
         self.count += 1
         self.weight_sum += weight
+
+    def add_partial_raw(
+        self,
+        raws: list,
+        count: int,
+        weight_sum: float | None = None,
+        scale: float = 1.0,
+    ) -> None:
+        """Count-weighted merge of a subtree's pre-folded partial SUM
+        (federated/partials.py): sums add once, but the mean's divisor
+        advances by the whole subtree — ``count`` leaf reports carrying
+        ``weight_sum`` total weight (= count when unweighted). ``scale``
+        serves the async (FedBuff) door: the subtree's staleness
+        discount applied to both the payload and its weight, so the
+        flush divides by what was actually folded."""
+        if count < 1:
+            raise E.PyGridError("cannot fold a zero-count partial report")
+        if self.sums is None:
+            self.sums = [
+                np.zeros(rt.shape, dtype=np.float64) for rt in raws
+            ]
+        saved_count, saved_weight = self.count, self.weight_sum
+        self.add_raw(raws, weight=scale)
+        self.count = saved_count + int(count)
+        self.weight_sum = saved_weight + scale * float(
+            weight_sum if weight_sum is not None else count
+        )
 
     def mean(self) -> list[np.ndarray]:
         if self.sums is None or self.weight_sum <= 0.0:
@@ -499,6 +540,317 @@ class CycleManager:
                     self._accum.pop(cycle.id, None)
         tasks.run_task_once(f"complete_cycle_{cycle.id}", self.complete_cycle, cycle.id)
 
+    # --- hierarchical (sub-aggregated) reports ------------------------------
+
+    def _resolve_partial_entries(
+        self, entries: list[tuple[str, str]]
+    ) -> tuple[S.Cycle, list[S.WorkerCycle], bool]:
+        """Resolve every (worker_id, request_key) of a partial against
+        ONE process — the node validates each member, so a sub-aggregator
+        adds no trust surface over direct reports. Returns ``(cycle,
+        worker_cycles, any_rehomed)``; sync callers additionally require
+        one OPEN cycle, async callers one process (stale keys re-home
+        like direct FedBuff reports)."""
+        cycle: S.Cycle | None = None
+        rehomed = False
+        wcs: list[S.WorkerCycle] = []
+        seen: set[str] = set()
+        by_worker: dict[str, S.WorkerCycle] = {}
+        for worker_id, request_key in entries:
+            if worker_id in seen:
+                raise E.PyGridError(
+                    f"partial report lists worker {worker_id} twice"
+                )
+            seen.add(worker_id)
+            wc = by_worker.get(worker_id)
+            if wc is None or wc.request_key != request_key:
+                # cache miss (first entry, a different cycle's key, or a
+                # wrong key) → the full per-entry resolution door, which
+                # owns the typed error
+                try:
+                    c, wc = self.resolve_worker_cycle(
+                        worker_id, request_key
+                    )
+                except E.InvalidRequestKeyError:
+                    c, wc = self.resolve_worker_cycle(
+                        worker_id, request_key, include_completed=True
+                    )
+                    rehomed = True
+                if cycle is None:
+                    cycle = c
+                    # batch prefetch: chunked IN-list selects load every
+                    # member's row — a fanout-member partial must not
+                    # pay one query per worker, and fetching only ITS
+                    # workers keeps the cost O(fanout), not O(cycle).
+                    # Chunked because a partial may legally carry tens
+                    # of thousands of entries and SQLite caps bound
+                    # variables per statement (SQLITE_MAX_VARIABLE_
+                    # NUMBER, 999 on older builds)
+                    members = [w for w, _ in entries]
+                    by_worker = {
+                        row.worker_id: row
+                        for i in range(0, len(members), _SQL_IN_CHUNK)
+                        for row in self._worker_cycles.query(
+                            cycle_id=cycle.id,
+                            worker_id=members[i : i + _SQL_IN_CHUNK],
+                            columns=(
+                                "id", "cycle_id", "worker_id",
+                                "request_key", "is_completed",
+                                "assigned_checkpoint", "started_at",
+                            ),
+                        )
+                    }
+                elif c.fl_process_id != cycle.fl_process_id:
+                    raise E.PyGridError(
+                        "partial report spans multiple FL processes"
+                    )
+            if wc.is_completed:
+                raise E.PyGridError(
+                    f"worker {worker_id} already reported for this "
+                    "assignment"
+                )
+            wcs.append(wc)
+        return cycle, wcs, rehomed
+
+    def submit_worker_partial(
+        self,
+        entries: list[tuple[str, str]],
+        diff: bytes,
+        count: int,
+        weight_sum: float | None = None,
+        masked: bool = False,
+        wire_codec: str | None = None,
+    ) -> None:
+        """Ingest one sub-aggregator partial: a subtree's pre-folded SUM
+        plus the (worker_id, request_key) list it covers. The fold is a
+        count-weighted merge into the same streaming accumulator the
+        flat path uses (``_DiffAccumulator.add_partial_raw``), straight
+        from the zero-copy wire views — per-worker tensors are never
+        materialized and the node's residency per frame is one partial,
+        regardless of how many workers stand behind it."""
+        from pygrid_tpu.federated.partials import (
+            MAX_PARTIAL_COUNT,
+            encode_partial_envelope,
+        )
+
+        if not entries:
+            raise E.PyGridError("partial report carries no worker entries")
+        if isinstance(count, bool) or not isinstance(count, int):
+            raise E.PyGridError("partial count must be an integer")
+        if count < 1:
+            raise E.PyGridError("cannot fold a zero-count partial report")
+        if count > MAX_PARTIAL_COUNT:
+            raise E.PyGridError(
+                f"partial count {count} exceeds {MAX_PARTIAL_COUNT}"
+            )
+        if count != len(entries):
+            raise E.PyGridError(
+                f"partial claims count {count} but carries "
+                f"{len(entries)} worker entries"
+            )
+        ws = float(weight_sum) if weight_sum is not None else float(count)
+        if not np.isfinite(ws) or not 0.0 < ws <= float(count):
+            # leaf weights are staleness discounts in (0, 1] — a weight
+            # beyond count would inflate the subtree's share of the mean
+            raise E.PyGridError(
+                f"partial weight_sum {ws} out of range (0, {count}]"
+            )
+        if not diff:
+            raise E.PyGridError("empty diff")
+        cycle, wcs, rehomed = self._resolve_partial_entries(entries)
+        pid = cycle.fl_process_id
+        async_cfg = self._async_config(pid)
+        if rehomed and async_cfg is None:
+            raise E.InvalidRequestKeyError()
+        # aggregation modes that need INDIVIDUAL diffs cannot accept a
+        # pre-summed subtree — reject typed so the sub-aggregator's
+        # workers fall back to direct reports
+        if self._robust_config(pid) is not None:
+            raise E.PyGridError(
+                "robust_aggregation needs individual diffs — partial "
+                "reports not accepted"
+            )
+        if self._dp_config(pid) is not None:
+            raise E.PyGridError(
+                "differential_privacy clips each client's diff at ingest "
+                "— partial reports not accepted"
+            )
+        if not self._uses_fallback_mean(pid):
+            raise E.PyGridError(
+                "a hosted averaging plan needs individual diffs — "
+                "partial reports not accepted"
+            )
+        secagg_cfg = self.secagg.config_for(pid)
+        if (secagg_cfg is not None) != bool(masked):
+            raise E.PyGridError(
+                "masked partial for a non-secagg process"
+                if masked
+                else "secure_aggregation process needs masked partials"
+            )
+        import time as _time
+
+        t0 = _time.perf_counter()
+        if masked:
+            # mod-2^32 partial of masked vectors: masks still cancel at
+            # the unmask round because masking is additive — the service
+            # validates every member against the mask set before any
+            # state change
+            self.secagg.ingest_masked_partial(
+                cycle.id,
+                [w for w, _ in entries],
+                diff,
+                self._model_shapes(pid),
+            )
+            self._mark_partial_rows(
+                wcs, encode_partial_envelope(diff, count, ws, masked=True)
+            )
+            self._note_partial(cycle, wcs, diff, wire_codec, count, t0)
+            tasks.run_task_once(
+                f"complete_cycle_{cycle.id}", self.complete_cycle, cycle.id
+            )
+            return
+        raws = state_raw_tensors(diff)
+        if raws is None or any(
+            rt.kind not in ("<f4", "<f8", "bf16") for rt in raws
+        ):
+            raise E.PyGridError("partial diff is not a dense State")
+        expected = self._model_shapes(pid)
+        got = [rt.shape for rt in raws]
+        if got != expected:
+            raise E.PyGridError(
+                f"diff shapes {got} do not match model shapes {expected}"
+            )
+        if async_cfg is not None:
+            self._submit_async_partial(
+                pid, wcs, raws, diff, count, ws, async_cfg
+            )
+            self._note_partial(cycle, wcs, diff, wire_codec, count, t0)
+            return
+        self._mark_partial_rows(
+            wcs, encode_partial_envelope(diff, count, ws)
+        )
+        self._note_partial(cycle, wcs, diff, wire_codec, count, t0)
+        with self._accum_lock:
+            acc = self._accum.setdefault(cycle.id, _DiffAccumulator())
+            acc.add_partial_raw(raws, count, ws)
+        fresh = self._cycles.first(id=cycle.id)
+        if fresh is not None and fresh.is_completed:
+            # lost the race with completion (it rebuilt from blobs) —
+            # same orphan-drop as the flat path
+            with self._accum_lock:
+                self._accum.pop(cycle.id, None)
+        tasks.run_task_once(
+            f"complete_cycle_{cycle.id}", self.complete_cycle, cycle.id
+        )
+
+    def _submit_async_partial(
+        self,
+        pid: int,
+        wcs: list[S.WorkerCycle],
+        raws: list,
+        diff: bytes,
+        count: int,
+        ws: float,
+        cfg: dict,
+    ) -> None:
+        """FedBuff door for a partial: the subtree folds in under its
+        MEAN staleness discount (a pre-summed partial cannot re-weight
+        members individually; sub-aggregator flush windows are short, so
+        subtree members share a checkpoint in the common case — exact
+        then, documented approximation otherwise, docs/AGGREGATION.md)."""
+        from pygrid_tpu.federated.partials import encode_partial_envelope
+
+        model = self.model_manager.get(fl_process_id=pid)
+        latest = self.model_manager.latest_number(model.id)
+        power = float(cfg.get("staleness_power", 0.5))
+        scale = float(
+            np.mean(
+                [
+                    staleness_weight(
+                        latest - (wc.assigned_checkpoint or latest), power
+                    )
+                    for wc in wcs
+                ]
+            )
+        )
+        open_cycle = self.last(pid)
+        with self._accum_lock:
+            self._mark_partial_rows(
+                wcs, encode_partial_envelope(diff, count, ws)
+            )
+            acc = self._async_accum.setdefault(pid, _DiffAccumulator())
+            acc.add_partial_raw(raws, count, ws, scale=scale)
+        tasks.run_task_once(
+            f"complete_cycle_{open_cycle.id}", self.complete_cycle,
+            open_cycle.id,
+        )
+
+    def _mark_partial_rows(
+        self, wcs: list[S.WorkerCycle], envelope: bytes
+    ) -> None:
+        """Durability for a subtree: the partial envelope lands on the
+        FIRST member's row (the restart rebuild re-folds it with its
+        original count/weight); the other members complete with an empty
+        diff so readiness counts every worker exactly once without
+        storing the payload fanout× times — node storage per subtree is
+        one envelope, not one blob per worker.
+
+        Members first, envelope LAST: the statements aren't one
+        transaction, so a crash mid-way must fail SAFE — empty member
+        rows without an envelope drop the subtree from a restart
+        rebuild (first member's slot stays open, deadline recovers),
+        whereas an envelope committed before its members would DOUBLE-
+        count the subtree once those members re-reported directly."""
+        now = dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+        if len(wcs) > 1:
+            # batched UPDATEs (chunked IN-list — SQLite caps bound
+            # variables per statement) — a subtree completes in a few
+            # statements, not fanout+1
+            member_ids = [wc.id for wc in wcs[1:]]
+            for i in range(0, len(member_ids), _SQL_IN_CHUNK):
+                self._worker_cycles.modify(
+                    {"id": member_ids[i : i + _SQL_IN_CHUNK]},
+                    {"is_completed": True, "completed_at": now,
+                     "diff": b""},
+                )
+        self._worker_cycles.modify(
+            {"id": wcs[0].id},
+            {"is_completed": True, "completed_at": now, "diff": envelope},
+        )
+
+    def _note_partial(
+        self,
+        cycle: S.Cycle,
+        wcs: list[S.WorkerCycle],
+        diff: bytes,
+        wire_codec: str | None,
+        count: int,
+        t0: float,
+    ) -> None:
+        """Telemetry for one accepted partial — never raises."""
+        import time as _time
+
+        try:
+            telemetry.observe(
+                "aggregation_partial_fold_seconds",
+                max(0.0, _time.perf_counter() - t0),
+            )
+            telemetry.incr("aggregation_partials_total", 1, outcome="ok")
+            telemetry.incr("aggregation_leaf_reports_total", count)
+            telemetry.incr(
+                "report_bytes_total", len(diff), codec=wire_codec or "json"
+            )
+            tctx = telemetry.trace.current()
+            telemetry.timeline.worker_report(
+                cycle.id,
+                f"subtree[{count}]:{wcs[0].worker_id}",
+                n_bytes=len(diff),
+                codec=wire_codec or "json",
+                trace_id=tctx.trace_id if tctx is not None else None,
+            )
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.exception("partial report telemetry failed")
+
     #: self-reported metric bounds: values are observability telemetry,
     #: not trusted statistics — the caps bound any single worker's
     #: influence on the aggregate curve (they cannot make it trustworthy
@@ -607,6 +959,26 @@ class CycleManager:
         return sorted(out, key=lambda e: e["cycle"])
 
     # --- telemetry surface --------------------------------------------------
+
+    def stats(self) -> dict:
+        """Flight-recorder stats provider (periodic engine snapshots):
+        the live aggregation state — per-cycle accumulator fill and the
+        FedBuff buffers — so a crash dump shows how far each fold got
+        before the crash."""
+        with self._accum_lock:
+            cycles = {
+                str(cid): {"count": acc.count, "weight_sum": acc.weight_sum}
+                for cid, acc in self._accum.items()
+            }
+            buffers = {
+                str(pid): {"count": acc.count, "weight_sum": acc.weight_sum}
+                for pid, acc in self._async_accum.items()
+            }
+        return {
+            "cycle_accumulators": cycles,
+            "fedbuff_buffers": buffers,
+            "armed_deadlines": len(self._deadline_timers),
+        }
 
     def cycle_timeline(self, cycle_id: int) -> dict | None:
         """The round timeline `GET /telemetry/cycles/<id>` serves: the
@@ -727,6 +1099,8 @@ class CycleManager:
         + staleness-weight) into a fresh accumulator. Weights recompute
         from each row's assigned_checkpoint against the current latest —
         the same formula ingest used."""
+        from pygrid_tpu.federated.partials import decode_partial_envelope
+
         cfg = self._async_config(fl_process_id) or {}
         model = self.model_manager.get(fl_process_id=fl_process_id)
         latest_number = self.model_manager.latest_number(model.id)
@@ -736,6 +1110,38 @@ class CycleManager:
                 id=ref.id, columns=("id", "diff", "assigned_checkpoint")
             )
             if row is None or not row.diff:
+                continue
+            env = None
+            try:
+                env = decode_partial_envelope(row.diff)
+            except E.PyGridError:
+                logger.warning(
+                    "async rebuild: dropping damaged partial envelope %s",
+                    ref.id,
+                )
+                continue
+            if env is not None:
+                # subtree envelope: re-fold under the envelope row's own
+                # staleness discount (the same subtree-mean approximation
+                # the live async door applied)
+                pcount, pws, _pm, pstate = env
+                praws = state_raw_tensors(pstate)
+                if praws is None:
+                    logger.warning(
+                        "async rebuild: dropping unreadable partial %s",
+                        ref.id,
+                    )
+                    continue
+                base = row.assigned_checkpoint or latest_number
+                acc.add_partial_raw(
+                    praws,
+                    pcount,
+                    pws,
+                    scale=staleness_weight(
+                        latest_number - base,
+                        float(cfg.get("staleness_power", 0.5)),
+                    ),
+                )
                 continue
             try:
                 decoded = self._decode_and_check(row.diff, fl_process_id)
@@ -1055,9 +1461,30 @@ class CycleManager:
                     cycle_id=cycle.id, is_completed=True
                 )
                 if acc is None or acc.count != n_received:
+                    from pygrid_tpu.federated.partials import (
+                        decode_partial_envelope,
+                    )
+
                     acc = _DiffAccumulator()
                     expected = self._model_shapes(process.id)
                     for d in self._received_diffs(cycle.id):
+                        env = decode_partial_envelope(d)
+                        if env is not None:
+                            # a stored subtree envelope re-folds with its
+                            # original count/weight — the rebuilt mean is
+                            # identical to the live fold's (DP processes
+                            # never accept partials, so no re-clip door)
+                            pcount, pws, _pmasked, pstate = env
+                            praws = state_raw_tensors(pstate)
+                            if praws is None or [
+                                rt.shape for rt in praws
+                            ] != expected:
+                                raise E.PyGridError(
+                                    "stored partial envelope does not "
+                                    "match model shapes"
+                                )
+                            acc.add_partial_raw(praws, pcount, pws)
+                            continue
                         # restart-recovery rebuild rides the same raw-view
                         # fold as live ingest: stored dense blobs
                         # accumulate straight from their wire buffers (no

@@ -49,6 +49,16 @@ class Cycle:
 
 @dataclass
 class WorkerCycle:
+    #: secondary indexes (created by the Warehouse): the report plane
+    #: resolves rows by (worker_id, request_key) once per report, counts
+    #: readiness by (cycle_id, is_completed) once per report, and scans
+    #: the FedBuff buffer by process — full table scans were invisible
+    #: at 64 workers and the wall at 10k
+    SQL_INDEXES = (
+        ("worker_id", "request_key"),
+        ("cycle_id", "is_completed"),
+        ("fl_process_id", "is_completed", "flushed"),
+    )
     id: int | None = None
     cycle_id: int = 0
     worker_id: str = ""

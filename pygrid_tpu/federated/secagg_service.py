@@ -407,6 +407,56 @@ class SecAggService:
                     np.add(s, m, out=s)  # uint32 wraparound = mod 2^32
             st.reported.add(worker_id)
 
+    def ingest_masked_partial(
+        self,
+        cycle_id: int,
+        worker_ids: list[str],
+        blob: bytes,
+        shapes: list[tuple],
+    ) -> None:
+        """Accumulate a sub-aggregator's pre-summed masked partial — the
+        mod-2^32 sum of its subtree's masked diffs. Additive masking
+        makes this safe: Σ(dᵢ + maskᵢ) ≡ Σdᵢ + Σmaskᵢ (mod 2^32), so the
+        pairwise masks cancel at the unmask round exactly as if each
+        worker had reported directly; the server still never sees a
+        plaintext diff (it sees strictly LESS than the flat path — only
+        the subtree sum). Every member is validated against the mask set
+        before any state change, so a partial cannot smuggle a
+        non-roster worker into the survivor set."""
+        masked = secagg.decode_masked_diff(blob)
+        got = [tuple(np.shape(t)) for t in masked]
+        if got != shapes:
+            raise E.PyGridError(
+                f"masked diff shapes {got} do not match model shapes {shapes}"
+            )
+        if not worker_ids:
+            raise E.PyGridError("masked partial carries no workers")
+        with self._lock:
+            st = self._cycles.get(cycle_id)
+            if st is None or st.phase != MASKING:
+                raise E.PyGridError(
+                    "secagg cycle not accepting masked reports"
+                )
+            for worker_id in worker_ids:
+                if worker_id not in st.mask_set:
+                    raise E.PyGridError(
+                        f"worker {worker_id} not in secagg mask set"
+                    )
+                if worker_id in st.reported:
+                    raise E.PyGridError(
+                        f"worker {worker_id} already reported"
+                    )
+            if len(set(worker_ids)) != len(worker_ids):
+                raise E.PyGridError("masked partial lists a worker twice")
+            if st.sums is None:
+                st.sums = [
+                    np.array(m, dtype=np.uint32, copy=True) for m in masked
+                ]
+            else:
+                for s, m in zip(st.sums, masked):
+                    np.add(s, m, out=s)  # uint32 wraparound = mod 2^32
+            st.reported.update(worker_ids)
+
     # ── readiness handoff (called by CycleManager._average_plan_diffs) ──────
 
     def begin_unmasking(self, cycle, server_config: dict) -> None:

@@ -47,6 +47,16 @@ class NetworkContext:
         from pygrid_tpu.telemetry.slo import SLOEngine, network_objectives
 
         self.slo = SLOEngine(network_objectives())
+        # hierarchical-aggregation placement: sub-aggregator registry +
+        # worker→sub-aggregator routing (docs/AGGREGATION.md); swept for
+        # liveness by the same monitor loop that heartbeats nodes
+        from pygrid_tpu import telemetry
+        from pygrid_tpu.network.aggregation import AggregationRegistry
+
+        self.aggregation = AggregationRegistry()
+        telemetry.recorder.register_stats_provider(
+            "aggregation", self.aggregation
+        )
 
     def proxy(self, node_id: str, address: str) -> NodeProxy:
         if node_id not in self.proxies:
@@ -84,6 +94,9 @@ def create_app(
 
         from pygrid_tpu.network.monitor import monitor_loop
 
+        # periodic engine snapshots: placement/tree trajectory on the
+        # flight-recorder ring (docs/OBSERVABILITY.md §7)
+        telemetry.recorder.start_snapshots()
         app_["monitor_task"] = asyncio.get_running_loop().create_task(
             monitor_loop(ctx)
         )
@@ -92,6 +105,11 @@ def create_app(
         task = app_.get("monitor_task")
         if task:
             task.cancel()
+        import asyncio
+
+        await asyncio.get_running_loop().run_in_executor(
+            None, telemetry.recorder.stop_snapshots
+        )
 
     app.on_startup.append(_start_monitor)
     app.on_cleanup.append(_stop_monitor)

@@ -152,6 +152,16 @@ async def monitor_loop(ctx) -> None:
                 else:
                     await poll_node(proxy)
             mark_degraded(ctx)
+            aggregation = getattr(ctx, "aggregation", None)
+            if aggregation is not None:
+                # heartbeat-loss sweep: a silent sub-aggregator stops
+                # receiving placements, so its subtree's workers fall
+                # back to direct node reports (docs/AGGREGATION.md)
+                for sid in aggregation.sweep():
+                    logger.warning(
+                        "sub-aggregator %s heartbeat lost — removed "
+                        "from placement", sid,
+                    )
         except Exception:  # noqa: BLE001 — keep the loop alive
             logger.exception("monitor sweep failed")
         await asyncio.sleep(ctx.monitor_interval)

@@ -99,6 +99,8 @@ async def metrics(request: web.Request) -> web.Response:
     for status in ("online", "degraded", "busy", "offline"):
         exp.gauge("grid_nodes", by_status.get(status, 0),
                   "nodes by monitor status", {"status": status})
+    exp.gauge("grid_subaggregators_total", len(ctx.aggregation.live()),
+              "live sub-aggregators registered for placement")
     # the telemetry bus: request latency by route, heartbeat RTT by
     # transport, monitor poll outcomes, event counters
     telemetry.export(exp)
@@ -269,6 +271,68 @@ async def search(request: web.Request) -> web.Response:
     return web.json_response({"match-nodes": matches})
 
 
+# ── hierarchical aggregation (docs/AGGREGATION.md) ──────────────────────────
+
+
+async def aggregation_register(request: web.Request) -> web.Response:
+    """A sub-aggregator registers (and re-registers as its heartbeat):
+    ``{subagg-id, subagg-address, node-address}`` — the node (or parent
+    sub-aggregator) address is the upstream its partials flow to."""
+    try:
+        data = json.loads(await request.text())
+        entry = _ctx(request).aggregation.register(
+            data["subagg-id"], data["subagg-address"], data["node-address"]
+        )
+    except (ValueError, KeyError, TypeError):
+        return web.json_response(
+            {"message": INVALID_JSON_FORMAT_MESSAGE}, status=400
+        )
+    return web.json_response(
+        {"message": "registered", "ttl_s": _ctx(request).aggregation.ttl_s,
+         "subagg-id": entry.subagg_id}
+    )
+
+
+async def aggregation_unregister(request: web.Request) -> web.Response:
+    try:
+        data = json.loads(await request.text())
+        ok = _ctx(request).aggregation.remove(data["subagg-id"])
+    except (ValueError, KeyError):
+        return web.json_response(
+            {"message": INVALID_JSON_FORMAT_MESSAGE}, status=400
+        )
+    return web.json_response(
+        {"message": "removed" if ok else "unknown sub-aggregator"},
+        status=200 if ok else 404,
+    )
+
+
+async def aggregation_placement(request: web.Request) -> web.Response:
+    """Worker→sub-aggregator routing: ``?node-address=…&worker-id=…`` →
+    ``{report-to: address | null}``. Null means report direct to the
+    node — the fallback whenever no live sub-aggregator serves it."""
+    node_address = request.query.get("node-address")
+    worker_id = request.query.get("worker-id")
+    if not node_address or not worker_id:
+        return web.json_response(
+            {"message": "node-address and worker-id are required"},
+            status=400,
+        )
+    entry = _ctx(request).aggregation.place(node_address, worker_id)
+    return web.json_response(
+        {
+            "report-to": entry.address if entry else None,
+            "subagg-id": entry.subagg_id if entry else None,
+        }
+    )
+
+
+async def aggregation_tree(request: web.Request) -> web.Response:
+    """The live tree topology + knobs (fanout/depth/ttl) for operators
+    and the dashboard."""
+    return web.json_response(_ctx(request).aggregation.tree())
+
+
 # ── monitor aggregates (reference routes/models.py, routes/dataset.py) ──────
 
 
@@ -358,6 +422,10 @@ def register(app: web.Application) -> None:
     r.add_get("/models", models)
     r.add_get("/datasets", datasets)
     r.add_get("/nodes-status", nodes_status)
+    r.add_post("/aggregation/register", aggregation_register)
+    r.add_delete("/aggregation/register", aggregation_unregister)
+    r.add_get("/aggregation/placement", aggregation_placement)
+    r.add_get("/aggregation/tree", aggregation_tree)
     r.add_get("/telemetry/slo", telemetry_slo)
     r.add_get("/healthz", healthz)
     r.add_post("/users/signup", _rbac_twin(USER_EVENTS.SIGNUP_USER))

@@ -164,6 +164,13 @@ def create_app(
         # windows have data even when no one scrapes. Clamped: 0 or a
         # negative knob would make the tick task a hot loop.
         telemetry.profiler.MEMORY.start()
+        # periodic engine snapshots (flight recorder §7): crash dumps
+        # carry a before-the-crash trajectory — cycle accumulators and
+        # serving stats every ~10 s under load, nothing when idle
+        telemetry.recorder.register_stats_provider(
+            f"aggregation:{app['node'].id}", app["node"].fl.cycle_manager
+        )
+        telemetry.recorder.start_snapshots()
         interval = max(1.0, env_float("PYGRID_SLO_INTERVAL_S", 15.0))
 
         async def _tick():
@@ -191,10 +198,13 @@ def create_app(
             # (CancelledError is a BaseException, not an Exception)
             with contextlib.suppress(asyncio.CancelledError, Exception):
                 await task
-        # the sampler stop() joins its thread (possibly mid-sample) —
-        # a blocking wait that must not run on the event loop
+        # the sampler/snapshotter stop() joins their threads (possibly
+        # mid-sample) — blocking waits that must not run on the event loop
         await asyncio.get_running_loop().run_in_executor(
             None, telemetry.profiler.MEMORY.stop
+        )
+        await asyncio.get_running_loop().run_in_executor(
+            None, telemetry.recorder.stop_snapshots
         )
 
     app.on_startup.append(_start_observability)

@@ -308,6 +308,60 @@ def report(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     }
 
 
+def report_partial(ctx: NodeContext, message: dict, conn: Connection) -> dict:
+    """A sub-aggregator's subtree report (docs/AGGREGATION.md): one
+    count-weighted partial diff sum plus the (worker_id, request_key)
+    pairs it folded — the node validates every pair exactly like a
+    direct report, then merges the sum into the cycle accumulator
+    straight from the zero-copy wire view."""
+    data = message.get(MSG_FIELD.DATA) or {}
+    response: dict[str, Any] = {}
+    try:
+        raw = data.get(CYCLE.DIFF) or b""
+        if isinstance(raw, str):
+            from pygrid_tpu.native import b64_decode_view
+
+            diff = b64_decode_view(raw)
+        else:
+            diff = raw if isinstance(raw, bytes) else bytes(raw)
+        workers = data.get("workers")
+        if not isinstance(workers, (list, tuple)):
+            raise E.PyGridError(
+                "partial report needs a 'workers' list of "
+                "[worker_id, request_key] pairs"
+            )
+        entries = []
+        for pair in workers:
+            if not isinstance(pair, (list, tuple)) or len(pair) != 2:
+                raise E.PyGridError(
+                    "each 'workers' entry must be a "
+                    "[worker_id, request_key] pair"
+                )
+            entries.append((str(pair[0]), str(pair[1])))
+        count = data.get("count", len(entries))
+        weight_sum = data.get("weight_sum")
+        if weight_sum is not None and (
+            isinstance(weight_sum, bool)
+            or not isinstance(weight_sum, (int, float))
+        ):
+            raise E.PyGridError("weight_sum must be a JSON number")
+        ctx.fl.submit_partial(
+            entries,
+            diff,
+            count,
+            weight_sum=weight_sum,
+            masked=bool(data.get("masked")),
+            wire_codec=conn.codec_label(),
+        )
+        response[CYCLE.STATUS] = SUCCESS
+    except Exception as err:  # noqa: BLE001 — protocol boundary
+        response[ERROR] = str(err)
+    return {
+        MSG_FIELD.TYPE: MODEL_CENTRIC_FL_EVENTS.REPORT_PARTIAL,
+        MSG_FIELD.DATA: response,
+    }
+
+
 def report_metrics(ctx: NodeContext, message: dict, conn: Connection) -> dict:
     """Client-reported training metrics for an assignment (this
     framework's extension — the reference has no structured metrics,
@@ -831,6 +885,7 @@ ROUTES: dict[str, Callable[[NodeContext, dict, Connection], dict]] = {
     MODEL_CENTRIC_FL_EVENTS.CYCLE_REQUEST: cycle_request,
     MODEL_CENTRIC_FL_EVENTS.GET_MODEL: get_model,
     MODEL_CENTRIC_FL_EVENTS.REPORT: report,
+    MODEL_CENTRIC_FL_EVENTS.REPORT_PARTIAL: report_partial,
     MODEL_CENTRIC_FL_EVENTS.REPORT_METRICS: report_metrics,
     MODEL_CENTRIC_FL_EVENTS.SECAGG_ADVERTISE: secagg_advertise,
     MODEL_CENTRIC_FL_EVENTS.SECAGG_ROSTER: secagg_roster,

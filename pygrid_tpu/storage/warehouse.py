@@ -387,6 +387,19 @@ class Warehouse:
             f"CREATE TABLE IF NOT EXISTS {self.table} ({', '.join(cols)})"
         )
         self._migrate_missing_columns()
+        # schema-declared secondary indexes (``SQL_INDEXES`` on the
+        # dataclass): the protocol hot paths look rows up by worker/cycle
+        # keys thousands of times per cycle — full scans were fine at 64
+        # workers and are the wall at 10k
+        for index_cols in getattr(self.schema, "SQL_INDEXES", ()):
+            cols_sql = ", ".join(f'"{c}"' for c in index_cols)
+            name = "ix_{}_{}".format(
+                self.schema.__name__.lower(), "_".join(index_cols)
+            )
+            self.db.execute(
+                f'CREATE INDEX IF NOT EXISTS "{name}" '
+                f"ON {self.table} ({cols_sql})"
+            )
 
     @property
     def _order_rowid(self) -> str:
@@ -488,6 +501,19 @@ class Warehouse:
         for k, v in filters.items():
             if v is None:
                 clauses.append(f'"{k}" IS NULL')
+            elif isinstance(v, (list, tuple, set)):
+                # membership filter → SQL IN: the batch UPDATE/SELECT the
+                # hierarchical report path needs (one statement for a
+                # whole subtree's rows, not one per worker)
+                values = list(v)
+                if not values:
+                    clauses.append("1 = 0")  # empty set matches nothing
+                else:
+                    marks = ", ".join("?" for _ in values)
+                    clauses.append(f'"{k}" IN ({marks})')
+                    params.extend(
+                        _encode(x, self._field_types.get(k)) for x in values
+                    )
             else:
                 clauses.append(f'"{k}" = ?')
                 params.append(_encode(v, self._field_types.get(k)))

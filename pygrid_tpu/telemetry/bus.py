@@ -124,6 +124,26 @@ _FAMILY_HELP: dict[str, str] = {
     "profiler_compile_seconds": "jitted-program calls that compiled, by kind",
     "profiler_execute_seconds": "jitted-program steady-state calls, by kind",
     "flightrecorder_dumps_total": "flight-recorder crash dumps, by reason",
+    "flightrecorder_snapshots_total": (
+        "periodic engine snapshots written to the flight-recorder ring"
+    ),
+    # hierarchical aggregation tree (docs/AGGREGATION.md)
+    "aggregation_partials_total": (
+        "partial subtree reports, by outcome (node accepts + edge flushes)"
+    ),
+    "aggregation_leaf_reports_total": (
+        "worker reports standing behind accepted partials"
+    ),
+    "aggregation_partial_fold_seconds": (
+        "node-side partial ingest: validate, zero-copy merge, durability"
+    ),
+    "aggregation_subaggs_total": (
+        "sub-aggregator placement registry churn, by outcome"
+    ),
+    "subagg_reports_total": (
+        "frames folded at a sub-aggregator, by kind (leaf/partial)"
+    ),
+    "subagg_flush_seconds": "one sub-aggregator upstream flush round trip",
     "telemetry_labels_dropped_total": (
         "label sets folded into {other} by the cardinality guard, by family"
     ),
@@ -142,6 +162,16 @@ def env_float(name: str, default: float) -> float:
 
     try:
         return float(os.environ[name])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer twin of :func:`env_float`, same never-brick contract."""
+    import os
+
+    try:
+        return int(os.environ[name])
     except (KeyError, TypeError, ValueError):
         return default
 

@@ -290,6 +290,95 @@ class FlightRecorder:
             self._providers.clear()
 
 
+#: default seconds between periodic engine snapshots (env-overridable)
+DEFAULT_SNAPSHOT_INTERVAL_S = 10.0
+
+
+class PeriodicSnapshotter:
+    """Low-cadence engine snapshots onto the flight-recorder ring
+    (docs/OBSERVABILITY.md §7): every ~10 s *under load*, one
+    ``engine.snapshot`` note carrying every registered stats provider's
+    live numbers — so a crash dump shows the trajectory BEFORE the
+    crash (queue depths climbing, a fold stalling), not just the final
+    frame. "Under load" is a counter-delta gate: an idle process writes
+    nothing, keeping the ring for real moments and the cost at zero.
+
+    Refcounted like the profiler's memory sampler: several apps in one
+    process (the test grid) share the thread; it stops with the last
+    ``stop()``."""
+
+    def __init__(
+        self, recorder: "FlightRecorder", interval_s: float | None = None
+    ) -> None:
+        self._recorder = recorder
+        self._interval_override = interval_s
+        self._stop_event = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._starts = 0
+        self._last_counters: dict | None = None
+        self.snapshots = 0  # taken (post-gate), for tests/stats
+
+    def _interval(self) -> float:
+        if self._interval_override is not None:
+            return self._interval_override
+        return max(
+            0.05,
+            bus.env_float(
+                "PYGRID_FLIGHT_SNAPSHOT_S", DEFAULT_SNAPSHOT_INTERVAL_S
+            ),
+        )
+
+    def start(self) -> None:
+        with self._lock:
+            self._starts += 1
+            if self._thread is not None:
+                return
+            self._stop_event.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="pygrid-flight-snapshot", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._starts = max(0, self._starts - 1)
+            if self._starts > 0 or self._thread is None:
+                return
+            thread = self._thread
+            self._thread = None
+        self._stop_event.set()
+        thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop_event.wait(self._interval()):
+            try:
+                self.snapshot_once()
+            except Exception:  # noqa: BLE001 — cadence must survive
+                logging.getLogger(__name__).exception(
+                    "periodic engine snapshot failed"
+                )
+
+    def snapshot_once(self, force: bool = False) -> bool:
+        """One gated snapshot; returns whether a note was written.
+        ``force`` skips the activity gate (tests, operator paths)."""
+        if not enabled():
+            return False
+        counters = dict(bus.counters())
+        if not force and counters == self._last_counters:
+            return False  # idle since the last tick — nothing to record
+        self._recorder.note(
+            "engine.snapshot",
+            stats=redact(self._recorder._provider_stats()),
+        )
+        self.snapshots += 1
+        bus.incr("flightrecorder_snapshots_total")
+        # the gate's baseline is the POST-snapshot counter state — the
+        # snapshot's own counter must not read as "activity" next tick
+        self._last_counters = dict(bus.counters())
+        return True
+
+
 def _slug(reason: str) -> str:
     return "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)[:48]
 
@@ -322,6 +411,8 @@ def _prune(directory: str) -> None:
 
 #: the process-wide recorder — module functions are its bound methods
 RECORDER = FlightRecorder()
+#: its periodic-snapshot driver (started by app lifecycles, refcounted)
+SNAPSHOTTER = PeriodicSnapshotter(RECORDER)
 
 note = RECORDER.note
 dump = RECORDER.dump
@@ -330,3 +421,5 @@ should_dump = RECORDER.should_dump
 ring = RECORDER.ring
 register_stats_provider = RECORDER.register_stats_provider
 reset = RECORDER.reset
+start_snapshots = SNAPSHOTTER.start
+stop_snapshots = SNAPSHOTTER.stop

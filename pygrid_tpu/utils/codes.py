@@ -56,6 +56,10 @@ class MODEL_CENTRIC_FL_EVENTS:
     #: reference (its download is HTTP-only)
     GET_MODEL = "model-centric/get-model"
     REPORT = "model-centric/report"
+    #: a sub-aggregator's pre-folded subtree report — one count-weighted
+    #: partial sum standing in for fanout× individual reports (this
+    #: framework's hierarchical-aggregation extension, docs/AGGREGATION.md)
+    REPORT_PARTIAL = "model-centric/report-partial"
     AUTHENTICATE = "model-centric/authenticate"
     CYCLE_REQUEST = "model-centric/cycle-request"
     REPORT_METRICS = "model-centric/report-metrics"

@@ -37,6 +37,32 @@ def default_data_fn(batch_size: int, features: int = 784, classes: int = 10):
     return X, y
 
 
+def lookup_aggregator(
+    network_url: str, node_url: str, worker_id: str
+) -> str | None:
+    """Ask the network's placement for this worker's report target: a
+    sub-aggregator address, or None for direct-to-node (no live
+    sub-aggregators registered for the node, or no network at all).
+    Best-effort by design — the hierarchy is an optimization, so an
+    unreachable network must never block a report."""
+    import requests
+
+    try:
+        resp = requests.get(
+            network_url.rstrip("/") + "/aggregation/placement",
+            params={
+                "node-address": node_url.rstrip("/"),
+                "worker-id": worker_id,
+            },
+            timeout=5,
+        )
+        if resp.status_code == 200:
+            return (resp.json() or {}).get("report-to") or None
+    except Exception:  # noqa: BLE001 — placement is best-effort
+        pass
+    return None
+
+
 def run_worker(
     node_url: str,
     model_name: str,
@@ -48,13 +74,18 @@ def run_worker(
     wire: str = "json",
     diff_precision: str | None = None,
     diff_compression: dict | None = None,
+    network_url: str | None = None,
 ) -> WorkerResult:
     """Participate in up to ``cycles`` FL cycles: authenticate → cycle
     request → download model+plan → local plan execution → report diff.
     A *rejected* cycle carries a retry window the node expects the worker
     to honor (reference fl_controller.py:160-172) — we sleep it (capped at
     ``max_retry_wait``) before the next request. ``wire="binary"`` switches
-    the event transport to msgpack frames with raw/bf16 diff payloads."""
+    the event transport to msgpack frames with raw/bf16 diff payloads.
+    ``network_url`` opts into hierarchical aggregation: before each
+    report the worker asks the network's placement for its
+    sub-aggregator (docs/AGGREGATION.md) and falls back to a direct
+    node report when none is live."""
     import time
 
     from pygrid_tpu.client.fl_client import FLClient
@@ -69,6 +100,16 @@ def run_worker(
             job.diff_compression = diff_compression
 
             def on_accepted(job: Any) -> None:
+                if network_url and not (
+                    diff_compression or job.client_config.get(
+                        "diff_compression"
+                    )
+                ):
+                    # sparse (top-k) diffs skip the tree: a sub-
+                    # aggregator folds dense payloads only
+                    client.aggregator_url = lookup_aggregator(
+                        network_url, node_url, job.worker_id
+                    )
                 plan = job.plans["training_plan"]
                 params = job.model_params
                 cfg = job.client_config or {}

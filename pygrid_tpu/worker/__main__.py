@@ -11,7 +11,41 @@ import sys
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="pygrid-tpu FL worker")
+    parser.add_argument(
+        "--role",
+        choices=("worker", "subagg"),
+        default="worker",
+        help="worker: train and report; subagg: run a sub-aggregator "
+        "that folds a subtree of worker reports into one partial per "
+        "flush (docs/AGGREGATION.md)",
+    )
     parser.add_argument("--node", required=True, help="node URL")
+    parser.add_argument(
+        "--network",
+        default=None,
+        help="network URL — workers ask it for sub-aggregator placement; "
+        "sub-aggregators register with it",
+    )
+    parser.add_argument(
+        "--listen-port",
+        type=int,
+        default=7001,
+        help="subagg role: port the sub-aggregator's WS endpoint serves on",
+    )
+    parser.add_argument(
+        "--advertise",
+        default=None,
+        help="subagg role: externally reachable URL registered for "
+        "placement (default http://127.0.0.1:<listen-port>, which only "
+        "works single-host — set this in any real deployment)",
+    )
+    parser.add_argument(
+        "--fanout",
+        type=int,
+        default=None,
+        help="subagg role: leaf reports per forwarded partial "
+        "(default PYGRID_AGG_FANOUT or 64)",
+    )
     parser.add_argument("--model-name", default="mnist")
     parser.add_argument("--model-version", default=None)
     parser.add_argument("--auth-token", default=None)
@@ -32,6 +66,22 @@ def main(argv=None) -> int:
         "tensor with error feedback carrying the rest to the next cycle",
     )
     args = parser.parse_args(argv)
+
+    if args.role == "subagg":
+        from aiohttp import web
+
+        from pygrid_tpu.worker.subagg import create_subagg_app
+
+        app = create_subagg_app(
+            args.node,
+            fanout=args.fanout,
+            network_url=args.network,
+        )
+        app["subagg"].address = (
+            args.advertise or f"http://127.0.0.1:{args.listen_port}"
+        )
+        web.run_app(app, port=args.listen_port)
+        return 0
 
     compression = None
     if args.compress:
@@ -57,6 +107,7 @@ def main(argv=None) -> int:
         wire="binary" if args.wire in ("binary", "bf16") else "json",
         diff_precision="bf16" if args.wire == "bf16" else None,
         diff_compression=compression,
+        network_url=args.network,
     )
     print(
         f"worker done: accepted={result.accepted} rejected={result.rejected} "

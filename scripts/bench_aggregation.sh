@@ -1,0 +1,25 @@
+#!/usr/bin/env sh
+# Hierarchical-aggregation bench: W simulated workers fold through
+# sub-aggregator partials into one live node over real wire-v2 sockets,
+# vs the flat per-worker leaf path — with a fanout sweep, peak-RSS
+# tracking, and a single-connection tracemalloc pass that shows node
+# allocation peaks flat from 64 to 1k workers (docs/AGGREGATION.md).
+# The smoke-scale assertions run under tier-1 via
+# tests/unit/test_bench_aggregation.py; the full capture lands in the
+# round's BENCH file via bench.py's protocol_hier section.
+#
+# Usage: scripts/bench_aggregation.sh [--smoke]
+#   default: 64/1k/10k workers, fanouts 64 and 256 (~5 min, CPU only)
+#   --smoke: 64/256 workers, fanout 32 (~30 s)
+set -e
+cd "$(dirname "$0")/.."
+if [ "$1" = "--smoke" ]; then
+    export PYGRID_BENCH_HIER_WORKERS=64,256
+    export PYGRID_BENCH_HIER_FANOUTS=32
+    export PYGRID_BENCH_HIER_FLAT=64
+fi
+JAX_PLATFORMS=cpu python -c "
+import json
+from bench import bench_protocol_hier
+print(json.dumps(bench_protocol_hier(), indent=2))
+"

@@ -1,0 +1,396 @@
+"""Hierarchical aggregation end to end: workers → sub-aggregator →
+node. The sub-aggregator folds each subtree's reports into one
+count-weighted partial (federated/partials.py), the node merges
+partials into the same streaming accumulator the flat path uses, and
+the resulting checkpoint is identical to flat FedAvg — exact for
+integer-valued diffs (f64 partial sums), which is the property the
+tree's correctness rests on. Also covered: network placement +
+heartbeat-loss expiry (a killed sub-aggregator must not strand the
+cycle — clients fall back to direct reports) and the SecAgg masked
+path through one sub-aggregator hop (masks cancel at the unmask round
+exactly as if every worker reported directly)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from pygrid_tpu.client import FLClient, ModelCentricFLClient, SecAggSession
+from pygrid_tpu.models import mlp
+from pygrid_tpu.plans.plan import Plan
+from pygrid_tpu.plans.state import serialize_model_params
+
+from .conftest import ServerThread, _free_port
+
+D, H, C, B = 12, 6, 4, 4
+W = 6          # workers per round
+FANOUT = 3     # leaf reports per forwarded partial
+
+
+@pytest.fixture(scope="module")
+def node():
+    from pygrid_tpu.federated import tasks
+    from pygrid_tpu.node import create_app
+
+    prev = tasks._sync
+    tasks.set_sync(True)  # partial ingest completes cycles inline
+    server = ServerThread(create_app("hier-node"), _free_port()).start()
+    yield server
+    tasks.set_sync(prev)
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def network(node):
+    from pygrid_tpu.network import create_app
+
+    server = ServerThread(
+        create_app("hier-network", monitor_interval=0.2), _free_port()
+    ).start()
+    server.app["network"].aggregation.ttl_s = 1.0  # fast expiry for tests
+    yield server
+    server.stop()
+
+
+def _subagg_server(node, network=None, **kwargs):
+    from pygrid_tpu.worker.subagg import create_subagg_app
+
+    app = create_subagg_app(
+        node.url,
+        fanout=kwargs.pop("fanout", FANOUT),
+        flush_interval=kwargs.pop("flush_interval", 0.2),
+        network_url=network.url if network else None,
+        register_interval=kwargs.pop("register_interval", 0.2),
+    )
+    server = ServerThread(app, _free_port()).start()
+    app["subagg"].address = server.url
+    return server
+
+
+def _host(node, name: str, *, n_workers: int = W, server_extra: dict | None = None):
+    params = [
+        np.asarray(p) for p in mlp.init(jax.random.PRNGKey(5), (D, H, C))
+    ]
+    plan = Plan(name="training_plan", fn=mlp.training_step)
+    plan.build(
+        np.zeros((B, D), np.float32),
+        np.zeros((B, C), np.float32),
+        np.float32(0.1),
+        *params,
+    )
+    mc = ModelCentricFLClient(node.url)
+    resp = mc.host_federated_training(
+        model=params,
+        client_plans={"training_plan": plan},
+        client_config={
+            "name": name, "version": "1.0",
+            "batch_size": B, "lr": 0.1, "max_updates": 1,
+        },
+        server_config={
+            "min_workers": n_workers,
+            "max_workers": n_workers,
+            "min_diffs": n_workers,
+            "max_diffs": n_workers,
+            "num_cycles": 1,
+            "do_not_reuse_workers_until_cycle": 0,
+            "pool_selection": "random",
+            **(server_extra or {}),
+        },
+    )
+    assert resp.get("status") == "success", resp
+    mc.close()
+    return params
+
+
+def _integer_diffs(params, n: int) -> list[list[np.ndarray]]:
+    """Integer-valued f32 diffs: exact in float64 sums regardless of
+    fold shape — the equality below is bitwise, not approximate."""
+    rng = np.random.default_rng(11)
+    return [
+        [
+            rng.integers(-3, 4, size=p.shape).astype(np.float32)
+            for p in params
+        ]
+        for _ in range(n)
+    ]
+
+
+def _report_round(node, name: str, diffs, aggregator_url=None) -> None:
+    """Drive W workers through assignment + report (diff supplied, not
+    trained — the tree's correctness is a fold property)."""
+    for i, diff in enumerate(diffs):
+        client = FLClient(node.url, timeout=30.0)
+        try:
+            auth = client.authenticate(name, "1.0")
+            assert not auth.get("error"), auth
+            wid = auth["worker_id"]
+            cyc = client.cycle_request(
+                wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+            )
+            assert cyc.get("status") == "accepted", (i, cyc)
+            client.aggregator_url = aggregator_url
+            out = client.report(
+                wid, cyc["request_key"], serialize_model_params(diff),
+                model_name=name,
+            )
+            assert not out.get("error"), (i, out)
+        finally:
+            client.close()
+
+
+def _latest(node, name: str):
+    mc = ModelCentricFLClient(node.url)
+    try:
+        return [np.asarray(p) for p in mc.retrieve_model(name, "1.0")]
+    finally:
+        mc.close()
+
+
+def test_tree_checkpoint_equals_flat_fedavg(node):
+    """Two identical processes, identical diffs: one flat, one through
+    a fanout-3 sub-aggregator. Integer-valued diffs → the tree-folded
+    checkpoint is BIT-IDENTICAL to the flat fold."""
+    params = _host(node, "hier-flat")
+    _host(node, "hier-tree")
+    diffs = _integer_diffs(params, W)
+
+    _report_round(node, "hier-flat", diffs)
+    flat_ckpt = _latest(node, "hier-flat")
+
+    subagg = _subagg_server(node)
+    try:
+        _report_round(node, "hier-tree", diffs, aggregator_url=subagg.url)
+        stats = subagg.app["subagg"].stats()
+        assert stats["reports"] == W, stats
+        # every leaf rode the tree: the count-1 eligibility probe, the
+        # fanout-triggered folds, and an interval flush for the tail
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            stats = subagg.app["subagg"].stats()
+            if stats["leaves_forwarded"] >= W:
+                break
+            time.sleep(0.05)
+        assert stats["leaves_forwarded"] == W, stats
+        assert stats["flushes"] >= 1 + (W - 1) // FANOUT, stats
+        assert stats["flush_errors"] == 0, stats
+        tree_ckpt = _latest(node, "hier-tree")
+    finally:
+        subagg.stop()
+
+    for a, b in zip(flat_ckpt, tree_ckpt):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_tail_flush_interval_completes_cycle(node):
+    """A subtree smaller than the fanout still flushes (interval timer)
+    — the cycle's tail never waits on reports that will not come."""
+    params = _host(node, "hier-tail", n_workers=2)
+    diffs = _integer_diffs(params, 2)
+    subagg = _subagg_server(node, fanout=50, flush_interval=0.15)
+    try:
+        _report_round(node, "hier-tail", diffs, aggregator_url=subagg.url)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if subagg.app["subagg"].stats()["leaves_forwarded"] >= 2:
+                break
+            time.sleep(0.05)
+        stats = subagg.app["subagg"].stats()
+        assert stats["flushes"] >= 1 and stats["leaves_forwarded"] == 2, stats
+    finally:
+        subagg.stop()
+    expected = [
+        p - np.mean([d[k] for d in diffs], axis=0)
+        for k, p in enumerate(params)
+    ]
+    got = _latest(node, "hier-tail")
+    for a, b in zip(got, expected):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-6)
+
+
+def test_placement_registration_and_heartbeat_loss(node, network):
+    """Network placement routes workers to a live sub-aggregator and
+    expires it on heartbeat loss; a client holding the dead address
+    falls back to a direct report, so the cycle completes anyway."""
+    subagg = _subagg_server(node, network=network)
+    agg_id = subagg.app["subagg"].id
+    # registration is a background task — wait for it to land
+    deadline = time.monotonic() + 10.0
+    placed = None
+    while time.monotonic() < deadline:
+        resp = requests.get(
+            network.url + "/aggregation/placement",
+            params={"node-address": node.url, "worker-id": "w-1"},
+            timeout=5,
+        )
+        placed = resp.json()
+        if placed.get("report-to"):
+            break
+        time.sleep(0.1)
+    assert placed and placed["report-to"] == subagg.url, placed
+    assert placed["subagg-id"] == agg_id
+    tree = requests.get(network.url + "/aggregation/tree", timeout=5).json()
+    assert node.url in tree["nodes"], tree
+
+    # worker-side lookup helper sees the same placement
+    from pygrid_tpu.worker import lookup_aggregator
+
+    assert lookup_aggregator(network.url, node.url, "w-1") == subagg.url
+
+    # kill it mid-cycle: registration expires within one TTL + sweep
+    dead_url = subagg.url
+    subagg.stop()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        resp = requests.get(
+            network.url + "/aggregation/placement",
+            params={"node-address": node.url, "worker-id": "w-1"},
+            timeout=5,
+        )
+        if resp.json().get("report-to") is None:
+            break
+        time.sleep(0.1)
+    assert resp.json().get("report-to") is None, resp.json()
+
+    # a client still holding the dead address completes its round via
+    # the direct fallback — the subtree's slots were never closed
+    params = _host(node, "hier-fallback", n_workers=2)
+    diffs = _integer_diffs(params, 2)
+    _report_round(node, "hier-fallback", diffs, aggregator_url=dead_url)
+    expected = [
+        p - np.mean([d[k] for d in diffs], axis=0)
+        for k, p in enumerate(params)
+    ]
+    for a, b in zip(_latest(node, "hier-fallback"), expected):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-6)
+
+
+def test_secagg_masked_cycle_through_one_hop(node):
+    """The Bonawitz rounds with every masked report riding a
+    sub-aggregator: the node ingests pre-summed masked partials (mod
+    2^32 — additive masks still cancel at unmask) and the checkpoint
+    equals plain FedAvg of the diffs to quantization precision."""
+    from pygrid_tpu.federated import secagg as secagg_mod
+
+    CLIP = 0.5
+    name = "hier-secagg"
+    params = _host(
+        node, name, n_workers=4,
+        server_extra={
+            "secure_aggregation": {
+                "clip_range": CLIP,
+                "threshold": 3,
+                "phase_timeout": 15.0,
+            }
+        },
+    )
+    subagg = _subagg_server(node, fanout=4, flush_interval=0.15)
+    results: dict[int, tuple] = {}
+
+    def run(i: int) -> None:
+        try:
+            client = FLClient(node.url, timeout=30.0)
+            auth = client.authenticate(name, "1.0")
+            wid = auth["worker_id"]
+            cyc = client.cycle_request(
+                wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+            )
+            assert cyc.get("status") == "accepted", cyc
+            session = SecAggSession(client, wid, cyc["request_key"])
+            session.advertise()
+            session.wait_roster(timeout=20.0)
+            session.upload_shares()
+            session.wait_masking(timeout=20.0)
+            rng = np.random.default_rng(300 + i)
+            diffs = [
+                rng.normal(0, 0.01, p.shape).astype(np.float32)
+                for p in params
+            ]
+            client.aggregator_url = subagg.url  # the one-hop under test
+            session.report(diffs)
+            phase = session.finish(timeout=40.0)
+            results[i] = (phase, diffs)
+            client.close()
+        except Exception as err:  # noqa: BLE001 — surfaced below
+            results[i] = ("error", err)
+
+    threads = [
+        threading.Thread(target=run, args=(i,), daemon=True)
+        for i in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+    finally:
+        subagg.stop()
+    errors = {i: r for i, r in results.items() if r[0] == "error"}
+    assert not errors, f"worker errors: {errors}"
+    assert all(phase in ("done", "closed") for phase, _ in results.values())
+    # every masked report actually rode the hop — none fell back direct
+    stats = subagg.app["subagg"].stats()
+    assert stats["leaves_forwarded"] == 4, stats
+    assert stats["flush_errors"] == 0, stats
+
+    diffs = [d for _, d in results.values()]
+    expected = [
+        p - np.mean([d[k] for d in diffs], axis=0)
+        for k, p in enumerate(params)
+    ]
+    step = 1.0 / secagg_mod.choose_scale(CLIP, 4)
+    for got, want in zip(_latest(node, name), expected):
+        np.testing.assert_allclose(
+            np.asarray(got), want, atol=4 * step + 1e-6
+        )
+
+
+def test_partial_report_typed_errors(node):
+    """Hostile/malformed partial frames bounce typed: zero count,
+    count/entry mismatch, unknown keys, weight_sum out of range."""
+    from pygrid_tpu.client.base import GridWSClient
+    from pygrid_tpu.utils.codes import MODEL_CENTRIC_FL_EVENTS
+
+    params = _host(node, "hier-errors", n_workers=2)
+    client = FLClient(node.url, timeout=30.0)
+    auth = client.authenticate("hier-errors", "1.0")
+    wid = auth["worker_id"]
+    cyc = client.cycle_request(
+        wid, "hier-errors", "1.0", ping=1.0, download=1000.0, upload=1000.0
+    )
+    assert cyc.get("status") == "accepted", cyc
+    blob = serialize_model_params(_integer_diffs(params, 1)[0])
+    ws = GridWSClient(node.url, offer_wire_v2=True)
+
+    def send(**data):
+        out = ws.send_msg_binary(
+            MODEL_CENTRIC_FL_EVENTS.REPORT_PARTIAL, data=data
+        )
+        return out.get("data", out)
+
+    key = cyc["request_key"]
+    # zero-count
+    out = send(workers=[], count=0, diff=blob)
+    assert "no worker entries" in out.get("error", ""), out
+    # count/entries mismatch
+    out = send(workers=[[wid, key]], count=2, diff=blob)
+    assert "claims count" in out.get("error", ""), out
+    # bad request key
+    out = send(workers=[[wid, "nope"]], count=1, diff=blob)
+    assert out.get("error"), out
+    # weight_sum beyond count
+    out = send(workers=[[wid, key]], count=1, weight_sum=3.0, diff=blob)
+    assert "out of range" in out.get("error", ""), out
+    # duplicate worker entry
+    out = send(workers=[[wid, key], [wid, key]], count=2, diff=blob)
+    assert "twice" in out.get("error", ""), out
+    # a valid single-worker partial still lands after all those bounces
+    out = send(workers=[[wid, key]], count=1, diff=blob)
+    assert out.get("status") == "success", out
+    ws.close()
+    client.close()

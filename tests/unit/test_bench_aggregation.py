@@ -1,0 +1,38 @@
+"""CI gate for the hierarchical report path (scripts/
+bench_aggregation.sh's twin): the streaming partial ingest must do
+ZERO tensor copies, hold node allocation peaks flat as the worker
+count grows, beat the flat leaf path, and fold to the exact flat
+checkpoint. Regressions here fail tier-1 rather than only showing up
+in the next BENCH capture."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from bench import bench_protocol_hier  # noqa: E402
+
+
+def test_hier_bench_smoke_zero_copy_flat_memory():
+    out = bench_protocol_hier(
+        workers=(64, 256), fanouts=(32,), flat_workers=64
+    )
+    for entry in out["hier"].values():
+        assert entry["cycle_completed"], out
+        # tree-folded checkpoint == flat FedAvg result (fp tolerance)
+        assert entry["checkpoint_ok"], out
+    # the read-only-view contract holds through the whole partial path:
+    # wire frame → PartialFold → _DiffAccumulator, no tensor copies
+    assert out["tensor_copies"] == 0, out
+    # hierarchical beats the flat leaf path even at smoke scale (the
+    # full sweep's 20×+ needs 1k+ workers; 2× is the smoke floor)
+    assert out["protocol_hier_speedup_vs_flat"] >= 2.0, out
+    # node allocation watermark flat as W grows: one partial in flight
+    # at a time, so the 4x worker count must not move the peak (±25%
+    # smoke tolerance; the full bench criterion is ±10% at 64→1k)
+    ratio = out["node_mem_peak_ratio_64_to_1k"]
+    assert ratio is not None and ratio <= 1.25, out
+    mem = list(out["memory"].values())
+    assert all(m["cycle_completed"] for m in mem), out

@@ -162,6 +162,114 @@ class TestGL1:
         """, TraceSafetyChecker)
         assert res.failures == []
 
+    def test_cross_module_call_reaches_side_effect(self, tmp_path):
+        """Two-pass whole-run closure: a jitted body in one module calls
+        a helper in another (module import); the helper's host side
+        effect fires, attributed to the helper's file."""
+        res = _lint(tmp_path, None, TraceSafetyChecker, files={
+            "pkg/__init__.py": "",
+            "pkg/serve.py": """
+                import jax
+                from pkg import helpers
+
+                @jax.jit
+                def traced(x):
+                    return helpers.step(x)
+            """,
+            "pkg/helpers.py": """
+                import time
+
+                def step(x):
+                    t0 = time.perf_counter()
+                    return x + t0
+            """,
+        })
+        assert _codes(res) == ["GL101"]
+        (f,) = res.failures
+        assert f.path == "pkg/helpers.py"
+        assert "cross-module call from pkg/serve.py" in f.message
+
+    def test_cross_module_from_import_and_second_hop(self, tmp_path):
+        """``from mod import fn`` bindings resolve too, and the closure
+        keeps walking: jitted → a.fn → b.deeper (two modules away)."""
+        res = _lint(tmp_path, None, TraceSafetyChecker, files={
+            "pkg/__init__.py": "",
+            "pkg/entry.py": """
+                import jax
+                from pkg.mid import run_step
+
+                traced = jax.jit(lambda x: run_step(x))
+            """,
+            "pkg/mid.py": """
+                from pkg.leaf import deeper
+
+                def run_step(x):
+                    return deeper(x)
+            """,
+            "pkg/leaf.py": """
+                def deeper(x):
+                    print("in trace!")
+                    return x
+            """,
+        })
+        assert _codes(res) == ["GL101"]
+        assert res.failures[0].path == "pkg/leaf.py"
+
+    def test_cross_module_clean_helper_is_quiet(self, tmp_path):
+        """Negative: the same cross-module shape with a pure helper —
+        and a module whose side-effecting function is NOT on the jitted
+        path — stays quiet."""
+        res = _lint(tmp_path, None, TraceSafetyChecker, files={
+            "pkg/__init__.py": "",
+            "pkg/serve.py": """
+                import jax
+                from pkg import helpers
+
+                @jax.jit
+                def traced(x):
+                    return helpers.step(x)
+
+                def host_only():
+                    return helpers.log_stats()
+            """,
+            "pkg/helpers.py": """
+                import time
+
+                def step(x):
+                    return x * 2
+
+                def log_stats():
+                    # reachable only OUTSIDE the trace
+                    return time.time()
+            """,
+        })
+        assert res.failures == []
+
+    def test_cross_module_duplicate_with_local_pass_folds(self, tmp_path):
+        """A helper that is jitted in ITS OWN module and also called
+        from another module's jitted body reports its effect once, not
+        twice."""
+        res = _lint(tmp_path, None, TraceSafetyChecker, files={
+            "pkg/__init__.py": "",
+            "pkg/a.py": """
+                import jax
+                from pkg import b
+
+                @jax.jit
+                def traced(x):
+                    return b.helper(x)
+            """,
+            "pkg/b.py": """
+                import jax
+
+                @jax.jit
+                def helper(x):
+                    print("effect")
+                    return x
+            """,
+        })
+        assert _codes(res) == ["GL101"]
+
 
 # ── GL2 thread/lock discipline ───────────────────────────────────────────
 

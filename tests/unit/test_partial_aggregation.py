@@ -1,0 +1,393 @@
+"""Count-weighted partial folds (federated/partials.py +
+``_DiffAccumulator.add_partial_raw``): the algebra the hierarchical
+report path rests on, property-tested over random tree shapes — a tree
+fold of ANY shape equals the flat fold exactly for integer-valued sums
+(float64 carries, no rounding) and within fp tolerance for arbitrary
+float means; zero-count partials raise the existing typed PyGridError
+at every level."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pygrid_tpu.federated.cycle_manager import _DiffAccumulator
+from pygrid_tpu.federated.partials import (
+    PartialFold,
+    decode_partial_envelope,
+    encode_partial_envelope,
+)
+from pygrid_tpu.plans.state import serialize_model_params
+from pygrid_tpu.serde import state_raw_tensors
+from pygrid_tpu.utils.exceptions import PyGridError
+
+SHAPES = [(3, 4), (7,), (2, 2, 2)]
+
+
+def _diffs(rng, n, integer=True, bf16=False):
+    out = []
+    for _ in range(n):
+        if integer:
+            d = [
+                rng.integers(-4, 5, size=s).astype(np.float32)
+                for s in SHAPES
+            ]
+        else:
+            d = [
+                rng.normal(0, 1, size=s).astype(np.float32) for s in SHAPES
+            ]
+        out.append(d)
+    return out
+
+
+def _blob(diff, bf16=False):
+    return serialize_model_params(diff, bf16=bf16)
+
+
+def _flat_mean(diffs):
+    acc = _DiffAccumulator()
+    for d in diffs:
+        acc.add_raw(state_raw_tensors(_blob(d)))
+    return acc.mean()
+
+
+def _tree_fold(rng, diffs, depth=0):
+    """Fold ``diffs`` through a RANDOM tree: split into 1-4 chunks,
+    recurse on each (a chunk may itself be a subtree), merge partials.
+    Returns a PartialFold standing for this subtree."""
+    fold = PartialFold()
+    if len(diffs) == 1 or depth >= 3:
+        for i, d in enumerate(diffs):
+            fold.add_report(f"w{id(d)}-{i}", f"k{i}", _blob(d))
+        return fold
+    n_chunks = int(rng.integers(1, min(4, len(diffs)) + 1))
+    bounds = sorted(
+        rng.choice(range(1, len(diffs)), size=n_chunks - 1, replace=False)
+    ) if n_chunks > 1 else []
+    chunks = np.split(np.arange(len(diffs)), bounds)
+    for chunk in chunks:
+        child = _tree_fold(rng, [diffs[i] for i in chunk], depth + 1)
+        blob, count, ws = child.to_report()
+        fold.add_partial(child.entries, blob, count, weight_sum=ws)
+    return fold
+
+
+def _fold_mean(fold: PartialFold):
+    blob, count, ws = fold.to_report()
+    acc = _DiffAccumulator()
+    acc.add_partial_raw(state_raw_tensors(blob), count, ws)
+    return acc.mean(), acc
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_any_tree_shape_equals_flat_fold_exactly(seed):
+    """Integer-valued f32 diffs: BIT-EQUAL through any tree shape —
+    float64 sums of integer values never round, so associativity is
+    exact and the root's divide matches the flat divide."""
+    rng = np.random.default_rng(seed)
+    diffs = _diffs(rng, int(rng.integers(2, 14)))
+    flat = _flat_mean(diffs)
+    tree_mean, acc = _fold_mean(_tree_fold(rng, diffs))
+    assert acc.count == len(diffs)
+    assert acc.weight_sum == float(len(diffs))
+    for a, b in zip(flat, tree_mean):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_float_diffs_match_within_fp_tolerance(seed):
+    rng = np.random.default_rng(100 + seed)
+    diffs = _diffs(rng, int(rng.integers(2, 14)), integer=False)
+    flat = _flat_mean(diffs)
+    tree_mean, _ = _fold_mean(_tree_fold(rng, diffs))
+    for a, b in zip(flat, tree_mean):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_bf16_leaves_fold_like_flat_bf16():
+    """bf16 wire payloads fold through the tree exactly as the flat
+    bf16 path folds them (same accum_bf16 kernel, same carries)."""
+    rng = np.random.default_rng(7)
+    diffs = _diffs(rng, 6)
+    flat = _DiffAccumulator()
+    for d in diffs:
+        flat.add_raw(state_raw_tensors(_blob(d, bf16=True)))
+    fold = PartialFold()
+    for i, d in enumerate(diffs):
+        fold.add_report(f"w{i}", f"k{i}", _blob(d, bf16=True))
+    tree_mean, _ = _fold_mean(fold)
+    for a, b in zip(flat.mean(), tree_mean):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_weighted_partials_compose():
+    """weight_sum < count (staleness-discounted subtrees) flows through
+    the merge: the mean divides by Σ weights, not the leaf count."""
+    rng = np.random.default_rng(3)
+    diffs = _diffs(rng, 4)
+    fold = PartialFold()
+    for i, d in enumerate(diffs[:2]):
+        fold.add_report(f"w{i}", f"k{i}", _blob(d))
+    blob, count, ws = fold.to_report()
+    acc = _DiffAccumulator()
+    acc.add_partial_raw(state_raw_tensors(blob), count, ws, scale=0.5)
+    assert acc.count == 2
+    assert acc.weight_sum == pytest.approx(1.0)  # 0.5 × 2
+    expected = [
+        0.5 * (a + b) / 1.0
+        for a, b in zip(diffs[0], diffs[1])
+    ]
+    for got, want in zip(acc.mean(), expected):
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_zero_count_partials_raise_typed_everywhere():
+    acc = _DiffAccumulator()
+    raws = state_raw_tensors(_blob(_diffs(np.random.default_rng(0), 1)[0]))
+    with pytest.raises(PyGridError, match="zero-count"):
+        acc.add_partial_raw(raws, 0)
+    with pytest.raises(PyGridError, match="zero-count"):
+        acc.add_partial_raw(raws, -3)
+    with pytest.raises(PyGridError, match="zero-count"):
+        PartialFold().to_report()
+    with pytest.raises(PyGridError, match="zero-count"):
+        PartialFold().add_partial([], b"x", 0)
+    # and the empty-cycle mean keeps its existing typed error
+    with pytest.raises(PyGridError, match="zero accepted reports"):
+        _DiffAccumulator().mean()
+
+
+def test_mixed_masked_and_plain_reports_bounce():
+    from pygrid_tpu.federated import secagg
+
+    rng = np.random.default_rng(5)
+    diff = _diffs(rng, 1)[0]
+    masked = secagg.encode_masked_diff(
+        [rng.integers(0, 2**32, size=s, dtype=np.uint32) for s in SHAPES]
+    )
+    fold = PartialFold()
+    fold.add_report("w0", "k0", _blob(diff))
+    with pytest.raises(PyGridError, match="mix masked and plain"):
+        fold.add_report("w1", "k1", masked)
+    fold2 = PartialFold()
+    fold2.add_report("w0", "k0", masked)
+    with pytest.raises(PyGridError, match="mix masked and plain"):
+        fold2.add_report("w1", "k1", _blob(diff))
+
+
+def test_masked_tree_sum_is_mod_2_32():
+    """Masked folds wrap mod 2^32 exactly like the node's flat masked
+    accumulator — the invariant SecAgg's mask cancellation needs."""
+    from pygrid_tpu.federated import secagg
+
+    rng = np.random.default_rng(9)
+    vecs = [
+        [
+            rng.integers(0, 2**32, size=s, dtype=np.uint32)
+            for s in SHAPES
+        ]
+        for _ in range(5)
+    ]
+    fold = PartialFold()
+    for i, v in enumerate(vecs):
+        fold.add_report(f"w{i}", f"k{i}", secagg.encode_masked_diff(v))
+    blob, count, _ = fold.to_report()
+    got = secagg.decode_masked_diff(blob)
+    for k in range(len(SHAPES)):
+        want = np.zeros(SHAPES[k], dtype=np.uint32)
+        for v in vecs:
+            want = want + v[k]  # uint32 wraparound
+        np.testing.assert_array_equal(got[k], want)
+    assert count == 5
+
+
+def test_shape_mismatch_bounces_typed():
+    rng = np.random.default_rng(2)
+    fold = PartialFold()
+    fold.add_report("w0", "k0", _blob(_diffs(rng, 1)[0]))
+    bad = [np.ones((9, 9), np.float32)]
+    with pytest.raises(PyGridError, match="shapes"):
+        fold.add_report("w1", "k1", serialize_model_params(bad))
+
+
+def test_sparse_diff_bounces_typed():
+    """Top-k sparse envelopes don't fold at the edge — typed bounce so
+    the worker retries direct-to-node."""
+    fold = PartialFold()
+    from pygrid_tpu.serde import serialize
+
+    sparse = serialize({"__pygrid_sparse_diff__": True, "tensors": []})
+    with pytest.raises(PyGridError):
+        fold.add_report("w0", "k0", sparse)
+
+
+def test_envelope_round_trip_and_damage():
+    rng = np.random.default_rng(4)
+    blob = _blob(_diffs(rng, 1)[0])
+    env = encode_partial_envelope(blob, 3, 2.5, masked=False)
+    assert decode_partial_envelope(env) == (3, 2.5, False, blob)
+    assert decode_partial_envelope(blob) is None  # plain State ≠ envelope
+    assert decode_partial_envelope(b"\x00garbage") is None
+    from pygrid_tpu.serde import serialize
+
+    damaged = serialize(
+        {"__pygrid_partial_diff__": True, "count": "NaN", "weight_sum": 1,
+         "state": b""}
+    )
+    with pytest.raises(PyGridError, match="malformed partial envelope"):
+        decode_partial_envelope(damaged)
+    out_of_range = serialize(
+        {"__pygrid_partial_diff__": True, "count": 0, "weight_sum": 1.0,
+         "state": b"x"}
+    )
+    with pytest.raises(PyGridError, match="out of range"):
+        decode_partial_envelope(out_of_range)
+
+
+def test_partial_fold_is_zero_copy():
+    """The edge fold never copies a tensor buffer: leaf reports
+    accumulate straight from their wire views (`tensor_copy_count`
+    regression hook, the same contract as node-side ingest)."""
+    from pygrid_tpu.serde import tensor_copy_count
+
+    rng = np.random.default_rng(6)
+    diffs = _diffs(rng, 8)
+    blobs = [_blob(d) for d in diffs]
+    before = tensor_copy_count()
+    fold = PartialFold()
+    for i, b in enumerate(blobs):
+        fold.add_report(f"w{i}", f"k{i}", b)
+    blob, count, ws = fold.to_report()
+    acc = _DiffAccumulator()
+    acc.add_partial_raw(state_raw_tensors(blob), count, ws)
+    acc.mean()
+    assert tensor_copy_count() - before == 0
+
+
+# ── SubAggregator fold/probe semantics (worker/subagg.py), upstream
+# stubbed — the wire/socket layer is covered by the integration tests ──
+
+
+class _StubUpstream:
+    """Records forwarded partials; answers a scripted error (or none)."""
+
+    def __init__(self, error: str | None = None):
+        self.error = error
+        self.sent: list[dict] = []
+
+    def send_msg_binary(self, event, data=None):
+        self.sent.append(data)
+        body = {"error": self.error} if self.error else {"status": "success"}
+        return {"type": event, "data": body}
+
+    def close(self):
+        pass
+
+
+def _subagg(fanout=3, error=None):
+    from pygrid_tpu.worker.subagg import SubAggregator
+
+    agg = SubAggregator(
+        "http://stub-node", fanout=fanout, flush_interval=999.0
+    )
+    agg._upstream = _StubUpstream(error)
+    return agg
+
+
+def _report(i):
+    rng = np.random.default_rng(100 + i)
+    return {
+        "worker_id": f"w{i}",
+        "request_key": f"k{i}",
+        "diff": _blob(_diffs(rng, 1)[0]),
+    }
+
+
+def test_subagg_probe_then_fanout_flush():
+    """First report per key probes upstream as a count-1 partial; the
+    next ``fanout`` buffer and flush as one frame."""
+    agg = _subagg(fanout=3)
+    agg.handle_report(_report(0))
+    assert len(agg._upstream.sent) == 1  # the eligibility probe
+    assert agg._upstream.sent[0]["count"] == 1
+    for i in (1, 2):
+        agg.handle_report(_report(i))
+    assert len(agg._upstream.sent) == 1  # still buffering
+    agg.handle_report(_report(3))
+    assert len(agg._upstream.sent) == 2  # fanout reached → one frame
+    sent = agg._upstream.sent[1]
+    assert sent["count"] == 3
+    assert [w for w, _ in sent["workers"]] == ["w1", "w2", "w3"]
+    stats = agg.stats()
+    assert stats["reports"] == 4
+    assert stats["leaves_forwarded"] == 4
+    assert stats["flush_errors"] == 0
+
+
+def test_subagg_ineligible_process_poisons_key():
+    """A process-config refusal at the probe poisons the fold key: the
+    probing worker AND every later one bounce typed (their clients fall
+    back to direct reports) with no further upstream round trips — an
+    incompatible process never silently eats a report."""
+    agg = _subagg(error="robust_aggregation needs individual diffs — "
+                        "partial reports not accepted")
+    with pytest.raises(PyGridError, match="partial reports not accepted"):
+        agg.handle_report(_report(0))
+    assert len(agg._upstream.sent) == 1
+    with pytest.raises(PyGridError, match="report direct"):
+        agg.handle_report(_report(1))
+    assert len(agg._upstream.sent) == 1  # poisoned: no second probe
+    assert agg.stats()["leaves_forwarded"] == 0
+
+
+def test_subagg_downstream_partial_probes_too():
+    """Depth-3 trees: a DOWNSTREAM sub-aggregator's partial through an
+    unproven mid-tier key probes upstream before the downstream peer is
+    acked — and a poisoned key bounces it the same way, so the
+    no-silent-loss guarantee holds at every tier."""
+    rng = np.random.default_rng(7)
+    down = PartialFold()
+    for i, d in enumerate(_diffs(rng, 2)):
+        down.add_report(f"d{i}", f"dk{i}", _blob(d))
+    blob, count, ws = down.to_report()
+    frame = {
+        "workers": [[w, k] for w, k in down.entries],
+        "count": count,
+        "weight_sum": ws,
+        "diff": blob,
+    }
+
+    agg = _subagg(fanout=10)
+    agg.handle_partial(dict(frame))
+    assert len(agg._upstream.sent) == 1  # forwarded synchronously
+    assert agg._upstream.sent[0]["count"] == 2
+    assert agg.stats()["leaves_forwarded"] == 2
+
+    poisoned = _subagg(error="a hosted averaging plan needs individual "
+                             "diffs — partial reports not accepted")
+    with pytest.raises(PyGridError, match="partial reports not accepted"):
+        poisoned.handle_partial(dict(frame))
+    with pytest.raises(PyGridError, match="report direct"):
+        poisoned.handle_partial(dict(frame))
+    assert len(poisoned._upstream.sent) == 1  # no second upstream trip
+
+
+def test_subagg_distinct_keys_fold_separately():
+    """The ``model`` hint keys the fold: two FL processes through one
+    sub-aggregator never mix sums, and each key probes independently."""
+    agg = _subagg(fanout=2)
+    a0, a1 = _report(0), _report(1)
+    b0, b1 = _report(2), _report(3)
+    for r in (a0, a1):
+        r["model"] = "proc-a@1.0"
+    for r in (b0, b1):
+        r["model"] = "proc-b@1.0"
+    agg.handle_report(a0)   # probe for proc-a
+    agg.handle_report(b0)   # probe for proc-b
+    assert len(agg._upstream.sent) == 2
+    agg.handle_report(a1)   # buffers under proc-a (fanout 2 not reached
+    agg.handle_report(b1)   # by mixing with proc-b's fold)
+    assert agg.stats()["buffered"] == {"proc-a@1.0": 1, "proc-b@1.0": 1}
+    agg.flush_all()
+    assert len(agg._upstream.sent) == 4
+    assert agg.stats()["leaves_forwarded"] == 4

@@ -1,0 +1,124 @@
+"""Periodic engine snapshots (telemetry/recorder.py): low-cadence
+flight-recorder notes so a crash dump carries a before-the-crash
+trajectory — gated on activity (idle processes write nothing), refcounted
+across apps, off-switched with the recorder."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pygrid_tpu import telemetry
+from pygrid_tpu.telemetry import recorder
+from pygrid_tpu.telemetry.recorder import FlightRecorder, PeriodicSnapshotter
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    monkeypatch.setenv("PYGRID_FLIGHT_DIR", str(tmp_path / "flight"))
+    telemetry.reset()
+    recorder.reset()
+    yield
+    telemetry.reset()
+    recorder.reset()
+
+
+def _snapshot_kinds(rec: FlightRecorder) -> list[dict]:
+    return [e for e in rec.ring() if e["kind"] == "engine.snapshot"]
+
+
+class _Engine:
+    def stats(self) -> dict:
+        return {"queue_depth": 3, "live_slots": 2}
+
+
+def test_snapshot_carries_provider_stats():
+    rec = FlightRecorder()
+    snap = PeriodicSnapshotter(rec)
+    engine = _Engine()
+    rec.register_stats_provider("engine", engine)
+    telemetry.incr("events_probe_total", 1)  # activity since process start
+    assert snap.snapshot_once() is True
+    (entry,) = _snapshot_kinds(rec)
+    assert entry["stats"]["engine"] == {"queue_depth": 3, "live_slots": 2}
+
+
+def test_idle_process_skips_snapshots():
+    """The activity gate: no counter movement between ticks → no note —
+    the ring stays reserved for real moments."""
+    rec = FlightRecorder()
+    snap = PeriodicSnapshotter(rec)
+    telemetry.incr("events_probe_total", 1)
+    assert snap.snapshot_once() is True
+    assert snap.snapshot_once() is False  # nothing moved
+    telemetry.incr("events_probe_total", 1)
+    assert snap.snapshot_once() is True
+    assert len(_snapshot_kinds(rec)) == 2
+
+
+def test_off_switch_disables_snapshots(monkeypatch):
+    monkeypatch.setenv("PYGRID_FLIGHT", "off")
+    rec = FlightRecorder()
+    snap = PeriodicSnapshotter(rec)
+    telemetry.incr("events_probe_total", 1)
+    assert snap.snapshot_once() is False
+    assert _snapshot_kinds(rec) == []
+
+
+def test_background_thread_ticks_under_load():
+    rec = FlightRecorder()
+    snap = PeriodicSnapshotter(rec, interval_s=0.02)
+    snap.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and snap.snapshots < 2:
+            telemetry.incr("events_probe_total", 1)  # keep it "loaded"
+            time.sleep(0.01)
+        assert snap.snapshots >= 2
+    finally:
+        snap.stop()
+    assert len(_snapshot_kinds(rec)) >= 2
+
+
+def test_refcounted_start_stop():
+    """Two apps share the snapshotter: the thread survives the first
+    stop and dies with the last."""
+    rec = FlightRecorder()
+    snap = PeriodicSnapshotter(rec, interval_s=0.02)
+    snap.start()
+    snap.start()
+    thread = snap._thread
+    assert thread is not None and thread.is_alive()
+    snap.stop()
+    assert snap._thread is thread and thread.is_alive()
+    snap.stop()
+    assert snap._thread is None
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+
+
+def test_aggregation_stats_provider_shape():
+    """The CycleManager registers as an aggregation-tree stats provider:
+    its stats() surface is dump-ready (plain JSON types)."""
+    import json
+
+    from pygrid_tpu.federated.cycle_manager import (
+        CycleManager,
+        _DiffAccumulator,
+    )
+
+    cm = CycleManager.__new__(CycleManager)  # stats() needs only state
+    import threading
+
+    cm._accum_lock = threading.Lock()
+    acc = _DiffAccumulator()
+    import numpy as np
+
+    acc.add([np.ones((2, 2), np.float32)])
+    cm._accum = {7: acc}
+    cm._async_accum = {}
+    cm._deadline_timers = {}
+    stats = cm.stats()
+    assert stats["cycle_accumulators"]["7"]["count"] == 1
+    json.dumps(stats)  # dump-ready
