@@ -1489,8 +1489,15 @@ def bench_serving(tiny: bool = False) -> dict:
     baseline_warm_s = time.perf_counter() - t0
 
     # ── engine: 8 requests in flight at once, fixed program set ─────────
+    import jax.numpy as jnp
+
     engine = GenerationEngine(
-        cfg, params, EngineConfig(max_slots=8), model_id="bench"
+        cfg, params,
+        # f32 cache pinned: the engine default is bf16 on TPU, but the
+        # per-request baseline above decodes with generate()'s f32
+        # cache — the equal-outputs assert must compare like for like
+        EngineConfig(max_slots=8, cache_dtype=jnp.float32),
+        model_id="bench",
     )
     try:
         engine.warmup(prompt_lens=(max(p.shape[1] for p, _ in cases),))
@@ -1551,6 +1558,209 @@ def bench_serving(tiny: bool = False) -> dict:
         f"{baseline_s:.2f}s incl. {len({n for _, n in cases})} compiles "
         f"({out['serving_throughput_ratio']}x), warm "
         f"{baseline_warm_s:.2f}s ({out['serving_throughput_ratio_warm']}x)",
+        file=sys.stderr,
+    )
+    return out
+
+
+def bench_serving_paged(tiny: bool = False) -> dict:
+    """Paged KV mode: concurrent-request capacity per GB of cache and
+    prefix-hit prefill savings vs the contiguous-slot baseline, at
+    EQUAL BYTE BUDGETS and equal (bit-identical greedy) outputs.
+
+    The pathology the paged cache removes: a contiguous slot pins
+    ``max_len`` tokens of k/v regardless of the request, so a node's
+    concurrent-request capacity per GB is ``1 / max_len`` rows per
+    token of cache no matter how short the traffic. The paged engine
+    holds only the pages covering prompt + n_new (block-table storage,
+    docs/SERVING.md), so the same bytes serve
+    ``max_len / (pages_per_request × block)`` × more concurrent
+    requests — measured here by DRIVING both engines with the same
+    short-request workload at the same cache bytes and asserting every
+    output equals single-request ``generate()``. The prefix phase then
+    shows shared-prefix prefill savings: N requests with one common
+    system prompt, the engine's prefix-hit counters proving all but the
+    first skipped the shared pages' prefill work. Zero recompiles under
+    shape AND prefix variety is asserted across the whole run."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from pygrid_tpu.models import decode, transformer
+    from pygrid_tpu.serving import EngineConfig, GenerationEngine
+
+    if tiny:
+        cfg = transformer.TransformerConfig(
+            vocab=127, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            max_len=64,
+        )
+        block = 16
+        contig_slots = 4
+        sys_prompt_pages = 2
+        n_prefix = 8
+    else:
+        cfg = transformer.TransformerConfig(
+            vocab=8192, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
+            max_len=512,
+        )
+        block = 64
+        contig_slots = 8
+        sys_prompt_pages = 4
+        n_prefix = 16
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    import jax.numpy as jnp
+
+    from pygrid_tpu.serving import pagedkv
+
+    kv_dtype = jnp.float32
+    # equal byte budgets: the contiguous baseline's S × max_len token
+    # slab, re-cut into `block`-token pages for the paged pool
+    cache_tokens = contig_slots * cfg.max_len
+    num_blocks = cache_tokens // block  # usable pages at byte parity
+    cache_bytes = cache_tokens * pagedkv.block_bytes(cfg, 1, kv_dtype)
+    paged_slots = num_blocks  # slots are ~free; blocks are the budget
+    rng = np.random.RandomState(11)
+
+    # the workload: every request fits one page (prompt + n_new ≤ block)
+    # with DISTINCT prompt lengths and n_new inside one bucket
+    cases = []
+    for i in range(paged_slots):
+        p_len = 4 + i % 5
+        n_new = block - p_len
+        prompt = rng.randint(0, cfg.vocab, size=(1, p_len)).astype(np.int32)
+        cases.append((prompt, n_new))
+    refs = [
+        np.asarray(decode.generate(params, p, n, cfg)) for p, n in cases
+    ]
+
+    def _drive(engine, cases):
+        outs: list = [None] * len(cases)
+
+        def _go(i):
+            prompt, n_new = cases[i]
+            outs[i] = engine.submit(prompt, n_new, timeout=600)
+
+        threads = [
+            threading.Thread(target=_go, args=(i,))
+            for i in range(len(cases))
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return outs, time.perf_counter() - t0
+
+    # ── contiguous-slot baseline at the same cache bytes ────────────────
+    # cache dtype pinned to f32 on BOTH engines: the engine default is
+    # backend-dependent (bf16 on TPU) while the generate() references
+    # below default to f32 — the bit-identity asserts must compare like
+    # for like on every backend (capacity/GB is dtype-orthogonal)
+    contig = GenerationEngine(
+        cfg, params,
+        EngineConfig(
+            max_slots=contig_slots, paged=False, cache_dtype=kv_dtype
+        ),
+        model_id="bench-contig",
+    )
+    try:
+        contig.warmup(prompt_lens=(8,))
+        contig_out, contig_s = _drive(contig, cases)
+        for got, ref in zip(contig_out, refs):
+            assert np.array_equal(got, ref), "contiguous != generate()"
+    finally:
+        contig.close()
+
+    # ── paged engine: same bytes, block-table storage ───────────────────
+    widths = tuple(sorted({1, 4, 8, paged_slots}))
+    sys_prompt = rng.randint(
+        0, cfg.vocab, size=sys_prompt_pages * block
+    ).astype(np.int32)
+    prefix_cases = []
+    for i in range(n_prefix):
+        suffix = rng.randint(0, cfg.vocab, size=4).astype(np.int32)
+        prefix_cases.append(
+            (np.concatenate([sys_prompt, suffix])[None, :], 6)
+        )
+    prefix_refs = [
+        np.asarray(decode.generate(params, p, n, cfg))
+        for p, n in prefix_cases
+    ]
+    engine = GenerationEngine(
+        cfg, params,
+        EngineConfig(
+            max_slots=paged_slots, slot_buckets=widths, paged=True,
+            block_size=block, num_blocks=num_blocks + 1,  # +1 = trash
+            max_queue=4 * paged_slots, cache_dtype=kv_dtype,
+        ),
+        model_id="bench-paged",
+    )
+    try:
+        # warm every bucket the run touches: the short prompts, the
+        # full system prompt chunk, and the post-hit suffix chunk
+        engine.warmup(
+            prompt_lens=(8, len(sys_prompt) + 4, 4 + 1)
+        )
+        compiles_before = engine.compile_count()
+
+        paged_out, paged_s = _drive(engine, cases)
+        for got, ref in zip(paged_out, refs):
+            assert np.array_equal(got, ref), "paged != generate()"
+
+        # ── shared-prefix phase: first request prefills + publishes,
+        # the rest map the system prompt's pages copy-on-write ─────────
+        first = engine.submit(*prefix_cases[0], timeout=600)
+        assert np.array_equal(first, prefix_refs[0])
+        rest_out, _ = _drive(engine, prefix_cases[1:])
+        for got, ref in zip(rest_out, prefix_refs[1:]):
+            assert np.array_equal(got, ref), "prefix-hit != generate()"
+        recompiles = engine.compile_count() - compiles_before
+        assert recompiles == 0, f"{recompiles} recompiles under traffic"
+        stats = engine.stats()
+        assert stats["prefix_hits"] >= n_prefix - 1, stats
+        saved_tokens = stats["prefix_tokens_saved"]
+        assert saved_tokens >= (n_prefix - 1) * len(sys_prompt), stats
+    finally:
+        engine.close()
+
+    # capacity: concurrent requests resident per GB of KV cache. The
+    # contiguous engine can hold at most its slot count regardless of
+    # request size; the paged engine is bounded by blocks — and the run
+    # above really did serve that many concurrently, bit-identically.
+    contig_capacity = contig_slots
+    paged_capacity = num_blocks  # 1 page/request workload, all resident
+    gb = cache_bytes / (1 << 30)
+    ratio = paged_capacity / contig_capacity
+    prefill_tokens_total = sum(
+        p.shape[1] for p, _ in prefix_cases
+    )
+    out = {
+        "paged_block_tokens": block,
+        "paged_cache_bytes": cache_bytes,
+        "paged_capacity_requests": paged_capacity,
+        "contig_capacity_requests": contig_capacity,
+        "paged_requests_per_gb": round(paged_capacity / gb, 1),
+        "contig_requests_per_gb": round(contig_capacity / gb, 1),
+        "paged_capacity_ratio": round(ratio, 2),
+        "paged_workload_s": round(paged_s, 3),
+        "contig_workload_s": round(contig_s, 3),
+        "paged_recompiles_under_traffic": recompiles,
+        "paged_prefix_hits": stats["prefix_hits"],
+        "paged_prefix_tokens_saved": saved_tokens,
+        "paged_prefix_prefill_saved_pct": round(
+            100.0 * saved_tokens / prefill_tokens_total, 1
+        ),
+    }
+    print(
+        f"serving-paged[{cfg.n_layers}L d{cfg.d_model}]: "
+        f"{paged_capacity} concurrent requests resident vs "
+        f"{contig_capacity} contiguous at equal {cache_bytes >> 20} MiB "
+        f"cache ({ratio:.1f}x capacity/GB), outputs bit-identical, "
+        f"0 recompiles; shared-prefix: {stats['prefix_hits']} hits, "
+        f"{saved_tokens} prompt tokens not re-prefilled "
+        f"({out['paged_prefix_prefill_saved_pct']}% of prefix-phase "
+        "prefill)",
         file=sys.stderr,
     )
     return out
@@ -2330,6 +2540,7 @@ def main() -> None:
     _guard("wire", bench_wire, proto)
     _guard("telemetry_overhead", bench_telemetry_overhead, proto)
     _guard("serving", bench_serving, proto)
+    _guard("serving_paged", bench_serving_paged, proto)
     _guard("protocol_json", lambda: bench_protocol("json"), proto)
     _guard("protocol_binary", lambda: bench_protocol("binary"), proto)
     _guard("protocol_hier", bench_protocol_hier, proto)

@@ -10,6 +10,18 @@ engine's no-recompile contract (``serving/programs.py``) also dies by a
 thousand ``jax.jit(...)(x)`` cuts: a jit built per call retraces per
 call.
 
+**GL104 — donation-after-use**: a ``jit(..., donate_argnums=...)``
+program may CONSUME its donated argument buffers (XLA reuses them for
+the output); reading the donated name after the call raises a
+``deleted buffer`` error at best and returns garbage at worst. The
+paged serving engine's block-pool swap discipline (``toks, self._k,
+self._v, self._pos = fn(self.params, self._k, ...)`` — donated names
+reassigned in the SAME statement) is exactly what the rule guards:
+per ``jit(...)`` site we record the donated positions, then flag any
+later straight-line read of a name that was passed at a donated
+position and not reassigned since. A reassignment (including by the
+call's own tuple unpack) revives the name.
+
 Detection is deliberately conservative: a function is *jitted* when it
 is decorated with ``jit``/``pjit`` (bare, dotted, or via
 ``partial(jax.jit, ...)``) or its name/lambda is passed as the first
@@ -238,6 +250,211 @@ class _TraceBodyScan(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _donate_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """Donated argnums if ``call`` is ``jit/pjit(..., donate_argnums=…)``
+    with literal positions; None otherwise (dynamic positions are out of
+    reach for a static rule — stay quiet, not wrong)."""
+    if not isinstance(call, ast.Call) or not _is_jit_callable(call.func):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out: list[int] = []
+            for elt in v.elts:
+                if not (
+                    isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)
+                ):
+                    return None
+                out.append(elt.value)
+            return tuple(out)
+        return None
+    return None
+
+
+def _find_donating_jit(expr: ast.AST) -> tuple[int, ...] | None:
+    """Donated positions of a ``jit(..., donate_argnums=…)`` call
+    anywhere in ``expr`` — wrappers preserve the signature, so
+    ``profiler.wrap(jax.jit(f, donate_argnums=(1,)), …)`` still donates
+    position 1 of the wrapped callable."""
+    for node in ast.walk(expr):
+        pos = _donate_positions(node) if isinstance(node, ast.Call) else None
+        if pos is not None:
+            return pos
+    return None
+
+
+class _DonationChecker:
+    """GL104 — donation-after-use, straight-line liveness per body.
+
+    Pass 1 records every name assigned from an expression containing a
+    donating jit; pass 2 walks each statement list in order: a call of
+    a donor (or an immediately-invoked donating jit) KILLS the dotted
+    names passed at donated positions, any read of a killed name is a
+    finding, and any assignment (including the killing call's own tuple
+    unpack — the engine's swap idiom) revives it. Kills never propagate
+    out of nested bodies and any nested assignment revives, so the rule
+    errs quiet, not wrong."""
+
+    def __init__(self, mod: ModuleContext) -> None:
+        self.mod = mod
+        self.findings: list[Finding] = []
+        self.donors: dict[str, tuple[int, ...]] = {}
+
+    def run(self) -> list[Finding]:
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                name = _dotted(node.targets[0])
+                if name is None:
+                    continue
+                pos = _find_donating_jit(node.value)
+                if pos is not None:
+                    self.donors[name] = pos
+        self._body(self.mod.tree.body, {})
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._body(node.body, {})
+        return self.findings
+
+    # ── statement-level helpers ──────────────────────────────────────
+
+    @staticmethod
+    def _assigned(stmt: ast.stmt) -> set[str]:
+        """Dotted names (re)bound anywhere within ``stmt``."""
+        out: set[str] = set()
+
+        def _targets(t: ast.AST) -> None:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    _targets(elt)
+            else:
+                name = _dotted(t)
+                if name is not None:
+                    out.add(name)
+
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    _targets(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                _targets(node.target)
+            elif isinstance(node, ast.For):
+                _targets(node.target)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                _targets(node.optional_vars)
+        return out
+
+    @staticmethod
+    def _walk_executed(stmt: ast.stmt):
+        """``ast.walk`` minus Lambda / nested-def subtrees: code in a
+        deferred body does NOT run at this statement's line, so a
+        donating call inside a callback must not kill names here."""
+        stack: list[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef),
+                ):
+                    continue
+                stack.append(child)
+
+    def _kills(self, stmt: ast.stmt) -> list[tuple[str, int]]:
+        """(dotted name, line) pairs donated by calls in ``stmt``."""
+        out: list[tuple[str, int]] = []
+        for node in self._walk_executed(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            positions = None
+            fname = _dotted(node.func)
+            if fname is not None and fname in self.donors:
+                positions = self.donors[fname]
+            elif isinstance(node.func, ast.Call):
+                # jit(f, donate_argnums=…)(args) invoked immediately
+                positions = _donate_positions(node.func)
+            if not positions:
+                continue
+            for i in positions:
+                if 0 <= i < len(node.args):
+                    name = _dotted(node.args[i])
+                    if name is not None:
+                        out.append((name, node.lineno))
+        return out
+
+    def _flag_reads(self, node: ast.AST, dead: dict[str, int]) -> None:
+        seen: set[tuple[str, int]] = set()  # one finding per name+line
+        for sub in ast.walk(node):
+            if not isinstance(sub, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(sub, "ctx", None), ast.Load):
+                continue
+            read = _dotted(sub)
+            if read is None:
+                continue
+            for name, line in dead.items():
+                if read != name and not read.startswith(name + "."):
+                    continue
+                key = (name, sub.lineno)
+                if key not in seen:
+                    seen.add(key)
+                    self.findings.append(
+                        self.mod.finding(
+                            "GL104",
+                            sub,
+                            f"'{name}' was passed at a donated position "
+                            f"(donate_argnums) on line {line} and read "
+                            "before reassignment — XLA may have consumed "
+                            "the buffer",
+                        )
+                    )
+                break
+
+    def _body(self, stmts: list[ast.stmt], dead: dict[str, int]) -> None:
+        dead = dict(dead)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate liveness domain (walked at run())
+            nested = [
+                sub
+                for attr in ("body", "orelse", "finalbody")
+                for sub in (getattr(stmt, attr, None) or [])
+                if isinstance(sub, ast.stmt)
+            ]
+            if nested:
+                # compound statement: only the header expressions are
+                # straight-line here — bodies get their own walk
+                for attr in ("test", "iter", "items"):
+                    header = getattr(stmt, attr, None)
+                    for part in header if isinstance(header, list) else (
+                        [header] if header is not None else []
+                    ):
+                        self._flag_reads(part, dead)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        self._body(sub, dead)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    self._body(handler.body, dead)
+                # an assignment anywhere inside revives (a kill inside
+                # stays inside): err quiet on branches
+                for name in self._assigned(stmt):
+                    dead.pop(name, None)
+                continue
+            assigned = self._assigned(stmt)
+            self._flag_reads(stmt, dead)
+            for name, line in self._kills(stmt):
+                if name not in assigned:
+                    dead[name] = line
+            for name in assigned:
+                dead.pop(name, None)
+
+
 class TraceSafetyChecker(Checker):
     name = "GL1"
     description = "host side-effects / recompile hazards under jax.jit"
@@ -245,6 +462,8 @@ class TraceSafetyChecker(Checker):
         "GL101": "host side-effect reachable inside a jitted function",
         "GL102": ".item() host sync inside a jitted function",
         "GL103": "jit-per-call / jit-in-loop recompile hazard",
+        "GL104": "donated buffer (donate_argnums) read after the jitted "
+        "call that consumed it",
     }
 
     def __init__(self) -> None:
@@ -370,6 +589,9 @@ class TraceSafetyChecker(Checker):
         jit_use.visit(mod.tree)
         for node, msg in jit_use.out:
             findings.append(mod.finding("GL103", node, msg))
+
+        # GL104: donation-after-use liveness
+        findings.extend(_DonationChecker(mod).run())
         return findings
 
     # ── pass 2: whole-run cross-module reachability ──────────────────────

@@ -262,6 +262,9 @@ class FLClient:
         #: the hierarchy is an optimization, never a correctness gate
         self.aggregator_url = aggregator_url
         self._agg_ws: GridWSClient | None = None
+        #: the address _agg_ws was dialed to — a cached socket is only
+        #: reused while placement still names the same sub-aggregator
+        self._agg_ws_url: str | None = None
 
     def new_job(self, model_name: str, model_version: str | None = None) -> FLJob:
         return FLJob(self, model_name, model_version)
@@ -512,12 +515,22 @@ class FLClient:
         from pygrid_tpu.utils.codes import MODEL_CENTRIC_FL_EVENTS
 
         try:
+            if (
+                self._agg_ws is not None
+                and self._agg_ws_url != self.aggregator_url
+            ):
+                # placement re-assigned this worker between cycles: a
+                # socket cached for the PREVIOUS sub-aggregator must
+                # not swallow reports meant for the new one
+                self._agg_ws.close()
+                self._agg_ws = None
             if self._agg_ws is None:
                 self._agg_ws = GridWSClient(
                     self.aggregator_url,
                     timeout=self._timeout,
                     offer_wire_v2=True,
                 )
+                self._agg_ws_url = self.aggregator_url
             response = self._agg_ws.send_msg_binary(
                 MODEL_CENTRIC_FL_EVENTS.REPORT,
                 data={

@@ -433,6 +433,216 @@ def decode_step_slots(
     return logits, SlotKVCache(k=new_k, v=new_v, pos=new_pos)
 
 
+# ── paged (block-table) shared cache ─────────────────────────────────────────
+#
+# The paged variant of the slot cache (PagedAttention, Kwon et al. SOSP '23;
+# prefix sharing after RadixAttention, Zheng et al.): instead of one
+# contiguous [max_len] region per slot, k/v live in ONE pool of fixed-size
+# blocks and each slot carries a block table mapping logical pages to pool
+# blocks. Short requests hold only the pages they use, and identical prompt
+# prefixes can share read-only pages copy-on-write (appends always land in a
+# request's own private pages — the engine allocates tables so a shared page
+# is never a scatter target). Block 0 is the TRASH block: never allocated,
+# the scatter target for pad positions and freed slots, never read unmasked.
+
+
+class PagedKVCache(NamedTuple):
+    """Block-pool key/value cache shared by independent requests.
+
+    ``k``/``v``: [n_layers, num_blocks, block, n_heads, head_dim]; ``pos``:
+    [S] int32 per-slot valid-row counts. Logical row ``j`` of slot ``s``
+    lives at pool block ``table[s, j // block]``, offset ``j % block`` —
+    the block table is a separate (engine-owned, host-updated) argument,
+    not part of this carry, because it only changes at admission/free.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+
+
+def init_paged_cache(
+    cfg: TransformerConfig,
+    slots: int,
+    num_blocks: int,
+    block: int,
+    dtype: Any = jnp.float32,
+) -> PagedKVCache:
+    dh = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, num_blocks, block, cfg.n_heads, dh)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        pos=jnp.zeros((slots,), jnp.int32),
+    )
+
+
+def paged_prefill_chunk(
+    params: Sequence[jax.Array],
+    cache: PagedKVCache,
+    table: jax.Array,
+    slot: jax.Array,
+    chunk: jax.Array,
+    start: jax.Array,
+    length: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    compute_dtype: Any | None = None,
+) -> tuple[jax.Array, PagedKVCache]:
+    """Dense prefill of one slot's prompt SUFFIX through its block table.
+
+    ``chunk``: [Pb] int32, the prompt's tokens from ``start`` on, padded
+    to a bucket width; ``start``: the global position of ``chunk[0]`` —
+    0 for a fresh prompt, or the (block-aligned) length of a shared
+    prefix whose pages the engine already mapped into ``table[slot]``;
+    ``length``: the TOTAL prompt length (start + true chunk length).
+    All three are traced, so one compiled program serves every prefix
+    split within a chunk bucket. Returns the logits at prompt position
+    ``length - 1`` ([vocab]) and the cache with the chunk's rows written
+    through the table and ``pos[slot] = length``.
+
+    Attention gathers the slot's logical rows [0, max_pages*block) from
+    the pool and masks to ``l <= start + p`` — a continuation chunk reads
+    the shared prefix it did not compute, which is the prefill work a
+    prefix hit saves. Pad positions (and any position past the table)
+    scatter into trash block 0, never into an allocated page, so a
+    SHARED page is never written by construction — that is the whole
+    copy-on-write discipline, enforced here rather than by the engine.
+    """
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def c(x):
+        return _cast(x, cd)
+
+    embed, pos_emb = params[0], params[1]
+    Pb = chunk.shape[0]
+    block = cache.k.shape[2]
+    max_pages = table.shape[1]
+    rows = max_pages * block
+    dh = cfg.d_model // cfg.n_heads
+    positions = start + jnp.arange(Pb)  # global positions, unclipped
+    h = c(embed[chunk] + pos_emb[jnp.minimum(positions, cfg.max_len - 1)])
+    row = table[slot]  # [max_pages]
+    real = jnp.arange(Pb) < (length - start)
+    page = jnp.minimum(positions // block, max_pages - 1)
+    #: pad scatter targets route to trash block 0 — a pad row must never
+    #: land in a real page (it could be SHARED with another request)
+    blk = jnp.where(real, row[page], 0)
+    off = jnp.where(real, positions % block, 0)
+    #: query at global position p sees rows [0, p]: the shared prefix
+    #: plus the chunk's own causal history (scattered just above)
+    mask = jnp.arange(rows)[None, :] <= positions[:, None]  # [Pb, rows]
+    scale = dh**-0.5
+
+    new_k, new_v = cache.k, cache.v
+    idx = 2
+    for layer in range(cfg.n_layers):
+
+        def attn(x, wq, wk, wv, layer=layer):
+            nonlocal new_k, new_v
+            q = (x @ wq).reshape(Pb, cfg.n_heads, dh)
+            # round k/v through the CACHE dtype before attending, like
+            # prefill_slot — decode reads these rows post-rounding and
+            # bit-identical greedy requires prefill to see the same
+            k = (x @ wk).reshape(Pb, cfg.n_heads, dh).astype(new_k.dtype)
+            v = (x @ wv).reshape(Pb, cfg.n_heads, dh).astype(new_v.dtype)
+            new_k = new_k.at[layer, blk, off].set(k)
+            new_v = new_v.at[layer, blk, off].set(v)
+            k_rows = new_k[layer, row].reshape(rows, cfg.n_heads, dh)
+            v_rows = new_v[layer, row].reshape(rows, cfg.n_heads, dh)
+            s = jnp.einsum(
+                "phd,lhd->hpl", q, k_rows,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(mask[None, :, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum(
+                "hpl,lhd->phd", p.astype(v_rows.dtype), v_rows,
+                preferred_element_type=jnp.float32,
+            ).reshape(Pb, cfg.d_model)
+
+        h = _block(h, params[idx : idx + PARAMS_PER_LAYER], c, attn)
+        idx += PARAMS_PER_LAYER
+    h_last = lax.dynamic_index_in_dim(
+        h, length - 1 - start, axis=0, keepdims=False
+    )
+    h_last = _ln(h_last, params[idx], params[idx + 1])
+    logits = jnp.dot(
+        c(h_last), c(embed).T, preferred_element_type=jnp.float32
+    )
+    return logits, PagedKVCache(
+        k=new_k, v=new_v, pos=cache.pos.at[slot].set(length)
+    )
+
+
+def paged_decode_step(
+    params: Sequence[jax.Array],
+    cache: PagedKVCache,
+    table: jax.Array,
+    token: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    compute_dtype: Any | None = None,
+) -> tuple[jax.Array, PagedKVCache]:
+    """One decode step for the first ``w`` slots through their block
+    tables — the paged twin of :func:`decode_step_slots`, same contract:
+    each slot at its own ``pos``, logits [w, vocab] f32, one row appended
+    per advanced slot. A free slot inside the width has a zeroed table
+    row, so its garbage write lands in trash block 0 — it can never
+    corrupt a block that was freed and reallocated to a live request.
+    """
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def c(x):
+        return _cast(x, cd)
+
+    embed, pos_emb = params[0], params[1]
+    w = token.shape[0]
+    block = cache.k.shape[2]
+    max_pages = table.shape[1]
+    rows = max_pages * block
+    dh = cfg.d_model // cfg.n_heads
+    t = cache.pos[:w]  # [w] per-slot positions
+    tw = table[:w]  # [w, max_pages]
+    page = jnp.minimum(t // block, max_pages - 1)
+    blk = jnp.take_along_axis(tw, page[:, None], axis=1)[:, 0]  # [w]
+    off = t % block
+    h = c(embed[token] + pos_emb[jnp.minimum(t, cfg.max_len - 1)])
+    mask = jnp.arange(rows)[None, :] <= t[:, None]  # [w, rows]
+    scale = dh**-0.5
+
+    new_k, new_v = cache.k, cache.v
+    idx = 2
+    for layer in range(cfg.n_layers):
+
+        def attn(x, wq, wk, wv, layer=layer):
+            nonlocal new_k, new_v
+            q = (x @ wq).reshape(w, cfg.n_heads, dh)
+            k = (x @ wk).reshape(w, cfg.n_heads, dh)
+            v = (x @ wv).reshape(w, cfg.n_heads, dh)
+            new_k = new_k.at[layer, blk, off].set(k.astype(new_k.dtype))
+            new_v = new_v.at[layer, blk, off].set(v.astype(new_v.dtype))
+            k_rows = new_k[layer][tw].reshape(w, rows, cfg.n_heads, dh)
+            v_rows = new_v[layer][tw].reshape(w, rows, cfg.n_heads, dh)
+            s = jnp.einsum(
+                "whd,wlhd->whl", q, k_rows,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(mask[:, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum(
+                "whl,wlhd->whd", p.astype(v_rows.dtype), v_rows,
+                preferred_element_type=jnp.float32,
+            ).reshape(w, cfg.d_model)
+
+        h = _block(h, params[idx : idx + PARAMS_PER_LAYER], c, attn)
+        idx += PARAMS_PER_LAYER
+    h = _ln(h, params[idx], params[idx + 1])
+    logits = jnp.dot(
+        c(h), c(embed).T, preferred_element_type=jnp.float32
+    )
+    new_pos = cache.pos.at[:w].add(1)
+    return logits, PagedKVCache(k=new_k, v=new_v, pos=new_pos)
+
+
 def generate(
     params: Sequence[jax.Array],
     prompt: jax.Array,

@@ -523,6 +523,33 @@ async def metrics(request: web.Request) -> web.Response:
                   "generation slots decoding right now", labels)
         exp.gauge("serving_max_slots", eng["max_slots"],
                   "generation slots in the shared KV cache", labels)
+        if eng.get("paged"):
+            # block-pool occupancy: free / used (held by live requests,
+            # INCLUDING cached blocks they share) / cached (reclaimable
+            # cache-only) — the three sum to the pool, and peak
+            # shared-prefix load reads as USED, not as cache bloat
+            total = eng["kv_blocks_total"]
+            free = eng["kv_blocks_free"]
+            idle_cached = eng["kv_blocks_idle_cached"]
+            for state, value in (
+                ("free", free),
+                ("used", max(0, total - free - idle_cached)),
+                ("cached", idle_cached),
+            ):
+                exp.gauge(
+                    "serving_kv_blocks", value,
+                    "paged KV pool blocks, by state (free/used/cached)",
+                    {**labels, "state": state},
+                )
+            exp.gauge(
+                "serving_kv_block_tokens", eng["block_size"],
+                "tokens per paged KV block", labels,
+            )
+            exp.gauge(
+                "serving_kv_fragmentation", eng["kv_fragmentation"],
+                "allocated-but-unwritten fraction of live KV pages",
+                labels,
+            )
     # the telemetry bus: event counters + every histogram family
     # (request latency by route, frame decode time, report latency,
     # cycle phases, wire bytes by codec, serde tensor copies)
@@ -676,15 +703,27 @@ async def telemetry_serving(request: web.Request) -> web.Response:
 
 async def telemetry_programs(request: web.Request) -> web.Response:
     """Compile-cache introspection: every jitted serving program's key,
-    bucket, compile ms, and hit count (telemetry/profiler.py) plus the
-    latest device-memory sample — the "compile vs execute vs host"
-    attribution surface for BENCH regressions."""
+    bucket, compile ms, hit count AND its XLA cost analysis (flops /
+    bytes accessed from ``jax.stages`` — rows ranked by total bytes
+    accessed, i.e. device pressure, not just wall-clock), plus the
+    latest device-memory sample. The cost pass re-lowers each program
+    once from captured avals; ``?cost=0`` (or PYGRID_PROFILER_COST=off)
+    skips it. The first costed snapshot runs off the event loop — a
+    lower/compile must not stall the sockets."""
+    include_cost = request.query.get("cost", "1") not in ("0", "false")
+    if include_cost:
+        programs = await _off_loop(
+            lambda: telemetry.profiler.programs_snapshot(include_cost=True)
+        )
+    else:
+        programs = telemetry.profiler.programs_snapshot()
     return web.json_response(
         {
-            "programs": telemetry.profiler.programs_snapshot(),
+            "programs": programs,
             "device_memory": telemetry.profiler.MEMORY.latest(),
             "device_memory_age_s": telemetry.profiler.MEMORY.age_s(),
             "profiler_enabled": telemetry.profiler.enabled(),
+            "cost_enabled": telemetry.profiler.cost_enabled(),
         }
     )
 

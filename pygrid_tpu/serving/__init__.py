@@ -14,7 +14,13 @@ import threading
 import weakref
 from typing import Any
 
+from pygrid_tpu.serving import pagedkv
 from pygrid_tpu.serving.engine import EngineConfig, GenerationEngine
+from pygrid_tpu.serving.pagedkv import (
+    BlockPool,
+    DeviceBudget,
+    PrefixCache,
+)
 from pygrid_tpu.serving.programs import (
     ProgramSet,
     prompt_buckets,
@@ -22,8 +28,11 @@ from pygrid_tpu.serving.programs import (
 )
 
 __all__ = [
+    "BlockPool",
+    "DeviceBudget",
     "EngineConfig",
     "GenerationEngine",
+    "PrefixCache",
     "ProgramSet",
     "ServingManager",
     "prompt_buckets",
@@ -41,8 +50,16 @@ class ServingManager:
     object), tracked with a weakref so the registry never pins a deleted
     model's params in memory."""
 
-    def __init__(self, config: EngineConfig | None = None) -> None:
+    def __init__(
+        self,
+        config: EngineConfig | None = None,
+        budget: DeviceBudget | None = None,
+    ) -> None:
         self.config = config or EngineConfig()
+        #: ONE device KV budget across every hosted model, partitioned
+        #: by admission weight (PYGRID_KV_BUDGET / PYGRID_KV_WEIGHTS);
+        #: without a budget each engine sizes its own pool
+        self.budget = budget if budget is not None else DeviceBudget.from_env()
         self._engines: dict[str, tuple[Any, GenerationEngine]] = {}
         self._lock = threading.Lock()
         # every flight-recorder crash dump carries the live engine
@@ -68,7 +85,9 @@ class ServingManager:
             hosted.generation_cache = decode.from_bundle(hosted.model)
         cfg, params = hosted.generation_cache
         engine = GenerationEngine(
-            cfg, params, config=self.config, model_id=str(model_id)
+            cfg, params,
+            config=self._config_for(str(model_id), cfg),
+            model_id=str(model_id),
         )
         with self._lock:
             entry = self._engines.get(model_id)
@@ -84,10 +103,37 @@ class ServingManager:
             stale.close()
         return winner
 
+    def _config_for(self, model_id: str, cfg) -> EngineConfig:
+        """Per-model engine config: when the node carries a unified KV
+        budget, size this model's block pool to its admission-weight
+        share (``weight / Σ weights × PYGRID_KV_BUDGET``); explicit
+        ``num_blocks``/``kv_budget_bytes`` on the base config win."""
+        base = self.config
+        if (
+            not pagedkv.paged_enabled(base.paged)
+            or base.num_blocks is not None
+            or base.kv_budget_bytes is not None
+            or self.budget.total_bytes is None
+        ):
+            return base
+        import dataclasses
+
+        block = pagedkv.resolve_block_size(cfg.max_len, base.block_size)
+        dtype = base.cache_dtype or base.compute_dtype
+        if dtype is None:
+            dtype = pagedkv.default_cache_dtype()
+        blocks = self.budget.blocks_for(
+            model_id, pagedkv.block_bytes(cfg, block, dtype)
+        )
+        if blocks is None:
+            return base
+        return dataclasses.replace(base, num_blocks=blocks)
+
     def evict(self, model_id: str) -> None:
         """Drop (and stop) the engine for a deleted/re-hosted model."""
         with self._lock:
             entry = self._engines.pop(model_id, None)
+        self.budget.release(model_id)
         if entry is not None:
             entry[1].close()
 

@@ -1,24 +1,41 @@
-"""Slot-based continuous-batching generation engine.
+"""Paged continuous-batching generation engine.
 
 One :class:`GenerationEngine` serves one hosted transformer bundle. It
-owns a persistent :class:`~pygrid_tpu.models.decode.SlotKVCache` of
-``max_slots`` request slots and a dedicated worker thread that runs the
+owns a persistent KV cache and a dedicated worker thread that runs the
 device loop — the Orca-style continuous-batching core (Yu et al., OSDI
-'22; slot cache after Kwon et al., SOSP '23):
+'22), with **paged block-table storage by default** (PagedAttention,
+Kwon et al. SOSP '23; prefix sharing after RadixAttention):
 
+- the cache is a pool of fixed-size KV blocks
+  (:class:`~pygrid_tpu.models.decode.PagedKVCache`); a request holds
+  only the pages covering its own prompt + ``n_new`` tokens instead of
+  a contiguous ``[max_len]`` slab, so short requests stop stranding
+  cache memory and the block pool — not the slot count — is what
+  admission exhausts;
+- identical prompt prefixes (hash-keyed full blocks, e.g. a common
+  system prompt) prefill ONCE and are mapped read-only into later
+  requests' block tables copy-on-write
+  (:class:`~pygrid_tpu.serving.pagedkv.PrefixCache`); refcounted blocks
+  free when the last reader completes;
 - requests wait in a bounded FIFO queue (admission past the depth limit
-  answers a typed :class:`~pygrid_tpu.utils.exceptions.ServerBusyError`
-  — backpressure, not an unbounded pile-up);
-- a free slot admits the oldest request via a per-slot dense prefill
-  (prompt padded to a bucket, true length traced) that rewrites only
-  that slot — live slots keep decoding undisturbed;
-- every step advances ALL live slots with one jitted decode program at
-  the narrowest width bucket covering them, each slot at its own
-  position — finished requests leave between steps while the rest keep
-  decoding, so short requests never wait for long ones;
+  — or block demand past the overcommit bound — answers a typed
+  :class:`~pygrid_tpu.utils.exceptions.ServerBusyError`);
+- a free slot admits the oldest request via a per-slot dense chunk
+  prefill (prompt suffix after the shared prefix, padded to a bucket,
+  true length traced) that writes only that request's pages — live
+  slots keep decoding undisturbed; when the pool is exhausted the row
+  parks at the queue head until completions free blocks;
+- every step advances ALL live slots with one jitted block-table decode
+  program at the narrowest width bucket covering them, each slot at its
+  own position — finished requests leave between steps while the rest
+  keep decoding, so short requests never wait for long ones;
 - at most ``quantum`` decode steps run between admission checks (the
   fairness cap: a queued request's time-to-first-token is bounded by
   one quantum even when the batch is full of long generations).
+
+``PYGRID_KV_PAGED=off`` (or ``EngineConfig(paged=False)``) falls back
+to the PR-3 contiguous slot cache — the operational escape hatch and
+the bench baseline for capacity-per-GB comparisons.
 
 Greedy results are bit-identical to single-request
 :func:`pygrid_tpu.models.decode.generate` (tested); sampling is
@@ -43,6 +60,7 @@ from typing import Any
 import numpy as np
 
 from pygrid_tpu import telemetry
+from pygrid_tpu.serving import pagedkv
 from pygrid_tpu.serving.programs import (
     ProgramSet,
     prompt_buckets,
@@ -56,12 +74,29 @@ logger = logging.getLogger(__name__)
 #: (the seconds ladder the bus defaults to is wrong for small integers)
 _OCCUPANCY_BOUNDS = [float(i) for i in range(1, 17)]
 
+#: blocks-per-request histogram bounds: a pages ladder, not seconds
+_BLOCKS_BOUNDS = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0]
+
 
 @dataclass(frozen=True)
 class EngineConfig:
     """Engine shape knobs. ``slot_buckets`` are decode widths to compile
     (always topped up with ``max_slots``); prompt buckets derive from
-    the model's ``max_len`` (see :func:`programs.prompt_buckets`)."""
+    the model's ``max_len`` (see :func:`programs.prompt_buckets`).
+
+    Paged-KV knobs (docs/SERVING.md): ``paged`` defaults to on
+    (``PYGRID_KV_PAGED=off`` opts out); ``block_size`` is the KV page
+    in tokens (``PYGRID_KV_BLOCK``, default 64, power-of-two-bucketed);
+    ``num_blocks`` overrides the pool size directly, else
+    ``kv_budget_bytes`` sizes it, else the pool defaults to byte parity
+    with the contiguous cache (``max_slots`` × pages-per-slot + trash);
+    ``kv_overcommit`` bounds how far QUEUED worst-case block demand may
+    run past the pool before enqueue answers busy — block exhaustion,
+    not slot exhaustion, is the admission limit. Per-model admission
+    weights for the node-wide device budget live on the
+    :class:`~pygrid_tpu.serving.pagedkv.DeviceBudget`
+    (``PYGRID_KV_WEIGHTS``), not here — one EngineConfig is shared by
+    every hosted model, so a per-model weight cannot ride on it."""
 
     max_slots: int = 8
     slot_buckets: tuple[int, ...] = (1, 4, 8)
@@ -71,6 +106,11 @@ class EngineConfig:
     default_timeout_s: float = 300.0
     compute_dtype: Any = None
     cache_dtype: Any = None
+    paged: bool | None = None
+    block_size: int | None = None
+    num_blocks: int | None = None
+    kv_budget_bytes: int | None = None
+    kv_overcommit: float = 4.0
 
 
 class _Row:
@@ -80,6 +120,7 @@ class _Row:
     __slots__ = (
         "pending", "row", "batch", "prompt", "n_new", "temperature",
         "seed", "keys", "out", "last_token", "enqueued_at", "admitted_at",
+        "pages", "shared_pages", "start", "demand",
     )
 
     def __init__(self, pending, row, batch, prompt, n_new, temperature, seed):
@@ -98,6 +139,14 @@ class _Row:
         self.last_token = 0
         self.enqueued_at = time.perf_counter()
         self.admitted_at: float | None = None
+        #: paged-KV bookkeeping — the row's block-table pages in page
+        #: order (shared prefix first), how many of them are shared,
+        #: the block-aligned prefix length, and the worst-case page
+        #: demand charged against the pool at enqueue
+        self.pages: list[int] | None = None
+        self.shared_pages = 0
+        self.start = 0
+        self.demand = 0
 
 
 class _Pending:
@@ -158,12 +207,53 @@ class GenerationEngine:
             else (
                 self.config.compute_dtype
                 if self.config.compute_dtype is not None
-                else jnp.float32
+                # bf16 on TPU (decode is bandwidth-bound on the cache
+                # sweep), f32 elsewhere — the parity tests pin both
+                else pagedkv.default_cache_dtype()
             )
         )
-        cache = decode.init_slot_cache(
-            cfg, self.config.max_slots, dtype=self._kv_dtype
-        )
+        self._paged = pagedkv.paged_enabled(self.config.paged)
+        if self._paged:
+            self._block = pagedkv.resolve_block_size(
+                cfg.max_len, self.config.block_size
+            )
+            self._max_pages = -(-cfg.max_len // self._block)
+            if self.config.num_blocks is not None:
+                num_blocks = int(self.config.num_blocks)
+            elif self.config.kv_budget_bytes is not None:
+                per_block = pagedkv.block_bytes(
+                    cfg, self._block, self._kv_dtype
+                )
+                # the trash block counts INSIDE the byte budget (same
+                # accounting as DeviceBudget.blocks_for): an operator
+                # sizing to available HBM must never be overshot
+                num_blocks = int(self.config.kv_budget_bytes) // per_block
+            else:
+                # byte parity with the contiguous slot cache — same
+                # footprint, but short requests free what they don't use
+                num_blocks = 1 + self.config.max_slots * self._max_pages
+            self._num_blocks = max(2, num_blocks)
+            self._pool = pagedkv.BlockPool(self._num_blocks)
+            self._prefix = pagedkv.PrefixCache(self._pool, self._block)
+            #: host mirror of the device block table; rebuilt lazily
+            #: (``_table``) after any admission/free edit
+            self._table_np = np.zeros(
+                (self.config.max_slots, self._max_pages), np.int32
+            )
+            self._table_dev = None
+            self._table_dirty = True
+            self._demand_pages = 0
+            self._prefix_hits = 0
+            self._prefix_misses = 0
+            self._prefix_tokens_saved = 0
+            cache = decode.init_paged_cache(
+                cfg, self.config.max_slots, self._num_blocks,
+                self._block, dtype=self._kv_dtype,
+            )
+        else:
+            cache = decode.init_slot_cache(
+                cfg, self.config.max_slots, dtype=self._kv_dtype
+            )
         # held as separate refs: the jitted programs donate and return
         # them, and the engine swaps in the new buffers every call
         self._k, self._v, self._pos = cache.k, cache.v, cache.pos
@@ -220,6 +310,24 @@ class GenerationEngine:
             )
             for b in range(batch)
         ]
+        if self._paged:
+            # worst-case page demand per row, credited with the pages
+            # the prefix cache ALREADY holds for this prompt (a probe —
+            # admission re-matches for real; an eviction in between
+            # just parks the row until blocks free)
+            pages_per_row = -(-(p_len + n_new) // self._block)
+            if pages_per_row > self._pool.usable:
+                raise E.PyGridError(
+                    f"request needs {pages_per_row} KV blocks of "
+                    f"{self._block} tokens but the pool holds "
+                    f"{self._pool.usable} — prompt + n_new can never "
+                    "be cached"
+                )
+            for row in rows:
+                row.demand = max(
+                    1, pages_per_row - self._prefix.probe(row.prompt)
+                )
+        demand = sum(r.demand for r in rows)
         with self._work:
             if not self._running:
                 raise E.PyGridError("generation engine is closed")
@@ -233,6 +341,21 @@ class GenerationEngine:
                     f"queued, depth limit {self.config.max_queue}) — "
                     "retry later"
                 )
+            if self._paged and self._demand_pages + demand > (
+                self.config.kv_overcommit * self._pool.usable
+            ):
+                telemetry.incr(
+                    "serving_requests_total", outcome="busy",
+                    model=self.model_id,
+                )
+                raise E.ServerBusyError(
+                    f"KV block pool exhausted ({self._demand_pages} "
+                    f"pages of demand outstanding against "
+                    f"{self._pool.usable} blocks, overcommit "
+                    f"{self.config.kv_overcommit:g}) — retry later"
+                )
+            if self._paged:
+                self._demand_pages += demand
             self._queue.extend(rows)
             self._requests += 1
             self._ensure_thread()
@@ -287,7 +410,7 @@ class GenerationEngine:
             queued = list(
                 dict.fromkeys(r.pending.request_id for r in self._queue)
             )
-            return {
+            out = {
                 "model_id": self.model_id,
                 "queue_depth": len(self._queue),
                 "live_slots": self._live,
@@ -297,7 +420,44 @@ class GenerationEngine:
                 "compiles_total": self.programs.compile_count(),
                 "slots": slots,
                 "queued_requests": queued,
+                "paged": self._paged,
             }
+            if self._paged:
+                live_rows = [r for r in self._slots if r is not None]
+                alloc_pages = sum(
+                    len(r.pages) for r in live_rows if r.pages is not None
+                )
+                used_tokens = sum(
+                    len(r.prompt) + len(r.out) for r in live_rows
+                )
+                out.update(
+                    {
+                        "block_size": self._block,
+                        "kv_blocks_total": self._pool.usable,
+                        "kv_blocks_free": self._pool.free_count(),
+                        "kv_blocks_cached": self._prefix.block_count(),
+                        # cache-ONLY (reclaimable) blocks; a cached
+                        # block shared with a live request counts as
+                        # used in the occupancy gauges, not cached
+                        "kv_blocks_idle_cached": (
+                            self._prefix.idle_block_count()
+                        ),
+                        "kv_demand_pages": self._demand_pages,
+                        # internal fragmentation of the LIVE allocation:
+                        # allocated-but-unwritten token slots (page-tail
+                        # waste) over allocated token slots
+                        "kv_fragmentation": round(
+                            1.0 - used_tokens / (alloc_pages * self._block),
+                            4,
+                        )
+                        if alloc_pages
+                        else 0.0,
+                        "prefix_hits": self._prefix_hits,
+                        "prefix_misses": self._prefix_misses,
+                        "prefix_tokens_saved": self._prefix_tokens_saved,
+                    }
+                )
+            return out
 
     def compile_count(self) -> int:
         return self.programs.compile_count()
@@ -323,19 +483,39 @@ class GenerationEngine:
             if bucket in seen:
                 continue
             seen.add(bucket)
-            fn = self.programs.prefill(bucket)
-            _tok, self._k, self._v, self._pos = fn(
-                self.params, self._k, self._v, self._pos,
-                jnp.int32(0), jnp.zeros((bucket,), jnp.int32),
-                jnp.int32(1), jnp.float32(0.0), zero_key,
-            )
+            if self._paged:
+                # all-zero table: every warmup write lands in the
+                # trash block, so no future request can observe it
+                fn = self.programs.paged_prefill(bucket)
+                _tok, self._k, self._v, self._pos = fn(
+                    self.params, self._k, self._v, self._pos,
+                    self._table(), jnp.int32(0),
+                    jnp.zeros((bucket,), jnp.int32), jnp.int32(0),
+                    jnp.int32(1), jnp.float32(0.0), zero_key,
+                )
+            else:
+                fn = self.programs.prefill(bucket)
+                _tok, self._k, self._v, self._pos = fn(
+                    self.params, self._k, self._v, self._pos,
+                    jnp.int32(0), jnp.zeros((bucket,), jnp.int32),
+                    jnp.int32(1), jnp.float32(0.0), zero_key,
+                )
         for w in self._widths:
-            fn = self.programs.decode(w)
-            _toks, self._k, self._v, self._pos = fn(
-                self.params, self._k, self._v, self._pos,
-                jnp.zeros((w,), jnp.int32), jnp.zeros((w,), jnp.float32),
-                jnp.zeros((w, 2), jnp.uint32),
-            )
+            if self._paged:
+                fn = self.programs.paged_decode(w)
+                _toks, self._k, self._v, self._pos = fn(
+                    self.params, self._k, self._v, self._pos,
+                    self._table(), jnp.zeros((w,), jnp.int32),
+                    jnp.zeros((w,), jnp.float32),
+                    jnp.zeros((w, 2), jnp.uint32),
+                )
+            else:
+                fn = self.programs.decode(w)
+                _toks, self._k, self._v, self._pos = fn(
+                    self.params, self._k, self._v, self._pos,
+                    jnp.zeros((w,), jnp.int32), jnp.zeros((w,), jnp.float32),
+                    jnp.zeros((w, 2), jnp.uint32),
+                )
 
     def close(self) -> None:
         """Stop the worker thread; queued/live requests fail typed."""
@@ -408,6 +588,17 @@ class GenerationEngine:
                 row = self._queue.popleft()
                 self._slots[slot] = row
                 self._live += 1
+            if self._paged and not self._assign_pages(slot, row):
+                # block pool exhausted even after prefix-cache
+                # eviction: park the row at the queue HEAD (FIFO order
+                # kept) until a completing request frees blocks — the
+                # loop keeps stepping the live slots, so progress is
+                # guaranteed
+                with self._lock:
+                    self._slots[slot] = None
+                    self._live = max(0, self._live - 1)
+                    self._queue.appendleft(row)
+                return
             now = time.perf_counter()
             row.admitted_at = now
             telemetry.observe(
@@ -417,21 +608,40 @@ class GenerationEngine:
                 row.keys = self._row_keys(
                     row.seed, row.row, row.batch, row.n_new
                 )
-            bucket = self._prompt_bucket(len(row.prompt))
-            padded = np.zeros(bucket, np.int32)
-            padded[: len(row.prompt)] = row.prompt
-            fn = self.programs.prefill(bucket)
             t0 = time.perf_counter()
-            # the cache buffers are single-writer: only the engine
-            # thread swaps _k/_v/_pos between lock epochs
-            # gridlint: disable-next=GL202
-            tok, self._k, self._v, self._pos = fn(
-                self.params, self._k, self._v, self._pos,
-                jnp.int32(slot), jnp.asarray(padded),
-                jnp.int32(len(row.prompt)),
-                jnp.float32(row.temperature),
-                self._key_for(row, 0),
-            )
+            if self._paged:
+                chunk_len = len(row.prompt) - row.start
+                bucket = self._prompt_bucket(chunk_len)
+                padded = np.zeros(bucket, np.int32)
+                padded[:chunk_len] = row.prompt[row.start :]
+                fn = self.programs.paged_prefill(bucket)
+                # the cache buffers are single-writer: only the engine
+                # thread swaps _k/_v/_pos between lock epochs
+                # gridlint: disable-next=GL202
+                tok, self._k, self._v, self._pos = fn(
+                    self.params, self._k, self._v, self._pos,
+                    self._table(), jnp.int32(slot), jnp.asarray(padded),
+                    jnp.int32(row.start), jnp.int32(len(row.prompt)),
+                    jnp.float32(row.temperature),
+                    self._key_for(row, 0),
+                )
+                # publish the full-prompt pages for future prefix hits
+                # (first prefill wins; a matched chain is only touched)
+                # gridlint: disable-next=GL202 — PrefixCache takes its own lock; only the engine thread mutates it
+                self._prefix.insert(row.prompt, row.pages)
+            else:
+                bucket = self._prompt_bucket(len(row.prompt))
+                padded = np.zeros(bucket, np.int32)
+                padded[: len(row.prompt)] = row.prompt
+                fn = self.programs.prefill(bucket)
+                # gridlint: disable-next=GL202 — engine-thread-confined
+                tok, self._k, self._v, self._pos = fn(
+                    self.params, self._k, self._v, self._pos,
+                    jnp.int32(slot), jnp.asarray(padded),
+                    jnp.int32(len(row.prompt)),
+                    jnp.float32(row.temperature),
+                    self._key_for(row, 0),
+                )
             first = int(tok)
             telemetry.observe(
                 "serving_ttft_seconds", time.perf_counter() - row.enqueued_at
@@ -440,6 +650,65 @@ class GenerationEngine:
                 "serving_prefill_seconds", time.perf_counter() - t0
             )
             self._emit(slot, row, first)
+
+    def _assign_pages(self, slot: int, row: _Row) -> bool:
+        """Map ``row`` into the block pool: match the longest cached
+        prompt prefix (refcounted, read-only — copy-on-write by the
+        scatter discipline in ``models/decode.py``), then allocate
+        private pages for the rest of prompt + n_new, evicting LRU
+        prefix entries under pressure. False = pool exhausted, caller
+        parks the row. Engine thread only."""
+        total_pages = -(-(len(row.prompt) + row.n_new) // self._block)
+        shared = self._prefix.match(row.prompt)
+        need = total_pages - len(shared)
+        priv = self._pool.alloc(need)
+        # eviction only ever targets nodes whose block actually frees
+        # (cache-only refs), so live-shared chains survive pressure and
+        # every True strictly grows the free list — no drain, no spin
+        while priv is None and self._prefix.evict_one():
+            priv = self._pool.alloc(need)
+        if priv is None:
+            if shared:
+                self._pool.release(shared)
+            return False
+        row.pages = shared + priv
+        row.shared_pages = len(shared)
+        row.start = len(shared) * self._block
+        self._table_np[slot, :] = 0
+        self._table_np[slot, : len(row.pages)] = row.pages
+        self._table_dirty = True
+        with self._lock:
+            if shared:
+                self._prefix_hits += 1
+                self._prefix_tokens_saved += row.start
+            else:
+                self._prefix_misses += 1
+        telemetry.incr(
+            "serving_prefix_lookups_total",
+            outcome="hit" if shared else "miss", model=self.model_id,
+        )
+        if shared:
+            telemetry.incr(
+                "serving_prefix_tokens_saved_total", row.start,
+                model=self.model_id,
+            )
+        telemetry.observe(
+            "serving_blocks_per_request", float(len(row.pages)),
+            bounds=_BLOCKS_BOUNDS,
+        )
+        return True
+
+    def _table(self):
+        """The device block table, rebuilt from the host mirror after
+        any admission/free edit. Engine thread only — the table is a
+        plain (non-donated) argument, so the same device array serves
+        every step between edits without a retrace."""
+        if self._table_dirty or self._table_dev is None:
+            import jax.numpy as jnp
+
+            self._table_dev = jnp.asarray(self._table_np)
+            self._table_dirty = False
+        return self._table_dev
 
     def _step(self) -> bool:
         """One batched decode step over every live slot; returns True if
@@ -464,13 +733,21 @@ class GenerationEngine:
             temps[i] = row.temperature
             if row.keys is not None:
                 keys[i] = row.keys[len(row.out)]
-        fn = self.programs.decode(width)
         t0 = time.perf_counter()
-        # gridlint: disable-next=GL202 — cache buffers are engine-thread-confined
-        toks, self._k, self._v, self._pos = fn(
-            self.params, self._k, self._v, self._pos,
-            jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
-        )
+        if self._paged:
+            fn = self.programs.paged_decode(width)
+            # gridlint: disable-next=GL202 — cache buffers are engine-thread-confined
+            toks, self._k, self._v, self._pos = fn(
+                self.params, self._k, self._v, self._pos, self._table(),
+                jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
+            )
+        else:
+            fn = self.programs.decode(width)
+            # gridlint: disable-next=GL202 — cache buffers are engine-thread-confined
+            toks, self._k, self._v, self._pos = fn(
+                self.params, self._k, self._v, self._pos,
+                jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
+            )
         toks = np.asarray(toks)
         dt = time.perf_counter() - t0
         telemetry.observe(
@@ -499,6 +776,8 @@ class GenerationEngine:
         with self._lock:
             self._slots[slot] = None
             self._live = max(0, self._live - 1)
+        if self._paged:
+            self._release_row(slot, row)
         row.pending.finish_row(row.row, row.out)
         if row.pending.remaining == 0:
             telemetry.incr(
@@ -506,6 +785,21 @@ class GenerationEngine:
                 model=self.model_id,
             )
         return True
+
+    def _release_row(self, slot: int, row: _Row) -> None:
+        """Return a retired row's pages to the pool (shared pages just
+        decref — the prefix cache and other readers keep theirs), zero
+        its table row so the freed slot's garbage decode writes land in
+        trash instead of a possibly-reallocated block, and refund its
+        enqueue-time demand. Engine thread only."""
+        if row.pages is not None:
+            self._pool.release(row.pages)
+            row.pages = None
+            self._table_np[slot, :] = 0
+            self._table_dirty = True
+        with self._lock:
+            self._demand_pages = max(0, self._demand_pages - row.demand)
+            row.demand = 0
 
     def _fail_all(self, err: Exception, reset_cache: bool = True) -> None:
         cache = None
@@ -521,17 +815,45 @@ class GenerationEngine:
             # buffers before raising — reallocate so the engine serves
             # the next request instead of failing forever on deleted
             # arrays (skipped on close: no one decodes again)
-            cache = decode.init_slot_cache(
-                self.cfg, self.config.max_slots, dtype=self._kv_dtype
-            )
+            if self._paged:
+                cache = decode.init_paged_cache(
+                    self.cfg, self.config.max_slots, self._num_blocks,
+                    self._block, dtype=self._kv_dtype,
+                )
+            else:
+                cache = decode.init_slot_cache(
+                    self.cfg, self.config.max_slots, dtype=self._kv_dtype
+                )
         with self._lock:
             rows = [r for r in self._slots if r is not None]
             rows.extend(self._queue)
             self._queue.clear()
             self._slots = [None] * self.config.max_slots
             self._live = 0
+            if self._paged:
+                self._demand_pages = 0
             if cache is not None:
                 self._k, self._v, self._pos = cache.k, cache.v, cache.pos
+        if self._paged:
+            if reset_cache:
+                # the device pool was reallocated: every cached prefix
+                # block now names stale (zeroed) data — rebuild the
+                # allocator and drop the prefix cache wholesale (engine
+                # thread only; every request future already failed above)
+                # gridlint: disable-next=GL202 — engine-thread-confined swap, requests already failed
+                self._pool = pagedkv.BlockPool(self._num_blocks)
+                # gridlint: disable-next=GL202 — engine-thread-confined swap, requests already failed
+                self._prefix = pagedkv.PrefixCache(self._pool, self._block)
+            else:
+                # clean close: refcounts must balance exactly (the
+                # leak test rides on this) — release each admitted
+                # row's pages individually
+                for row in rows:
+                    if row.pages is not None:
+                        self._pool.release(row.pages)
+                        row.pages = None
+            self._table_np[:] = 0
+            self._table_dirty = True
         failed: dict[int, str] = {}
         for row in rows:
             if id(row.pending) not in failed:

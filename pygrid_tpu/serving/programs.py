@@ -67,6 +67,8 @@ class ProgramSet:
         self.model_id = model_id
         self._prefill: dict[int, Callable] = {}
         self._decode: dict[int, Callable] = {}
+        self._paged_prefill: dict[int, Callable] = {}
+        self._paged_decode: dict[int, Callable] = {}
         self._compiles = 0
 
     def compile_count(self) -> int:
@@ -79,7 +81,12 @@ class ProgramSet:
         :meth:`compile_count` when the no-recompile contract holds;
         falls back to the builder count where jax lacks the hook."""
         total = 0
-        for fn in [*self._prefill.values(), *self._decode.values()]:
+        for fn in [
+            *self._prefill.values(),
+            *self._decode.values(),
+            *self._paged_prefill.values(),
+            *self._paged_decode.values(),
+        ]:
             size = getattr(fn, "_cache_size", None)
             total += size() if callable(size) else 1
         return total
@@ -157,4 +164,75 @@ class ProgramSet:
             )
             self._decode[width] = fn
             self._count("decode")
+        return fn
+
+    # ── paged (block-table) programs ────────────────────────────────────
+    #
+    # Same bucketing contract as the contiguous pair above: one compile
+    # per chunk/width bucket ever, with the block TABLE a plain traced
+    # argument (constant [S, max_pages] shape — table content changes at
+    # admission without retracing) and ``start``/``length`` traced so a
+    # prefix hit of any block-aligned depth reuses one program.
+
+    def paged_prefill(self, bucket: int) -> Callable:
+        """``fn(params, k, v, pos, table, slot, chunk[bucket], start,
+        length, temp, key) -> (first_token, k, v, pos)`` — admission of
+        one request through its block table, continuing after a shared
+        prefix of ``start`` tokens; first token picked on-device."""
+        fn = self._paged_prefill.get(bucket)
+        if fn is None:
+            import jax
+
+            from pygrid_tpu.models import decode
+
+            cfg, cd = self.cfg, self.compute_dtype
+
+            def _paged_prefill(
+                params, k, v, pos, table, slot, chunk, start, length,
+                temp, key,
+            ):
+                cache = decode.PagedKVCache(k=k, v=v, pos=pos)
+                logits, cache = decode.paged_prefill_chunk(
+                    params, cache, table, slot, chunk, start, length,
+                    cfg, cd,
+                )
+                tok = self._pick(logits, temp, key)
+                return tok, cache.k, cache.v, cache.pos
+
+            fn = telemetry.profiler.wrap(
+                jax.jit(_paged_prefill, donate_argnums=(1, 2, 3)),
+                kind="paged_prefill", bucket=bucket,
+                model_id=self.model_id,
+            )
+            self._paged_prefill[bucket] = fn
+            self._count("paged_prefill")
+        return fn
+
+    def paged_decode(self, width: int) -> Callable:
+        """``fn(params, k, v, pos, table, tokens[w], temps[w],
+        keys[w, 2]) -> (next_tokens[w], k, v, pos)`` — one block-table
+        step for the first ``w`` slots, each at its own position."""
+        fn = self._paged_decode.get(width)
+        if fn is None:
+            import jax
+
+            from pygrid_tpu.models import decode
+
+            cfg, cd = self.cfg, self.compute_dtype
+
+            def _paged_decode_step(params, k, v, pos, table, tokens, temps, keys):
+                cache = decode.PagedKVCache(k=k, v=v, pos=pos)
+                logits, cache = decode.paged_decode_step(
+                    params, cache, table, tokens, cfg, cd
+                )
+                toks = jax.vmap(self._pick)(logits, temps, keys)
+                return toks, cache.k, cache.v, cache.pos
+
+            fn = telemetry.profiler.wrap(
+                jax.jit(_paged_decode_step, donate_argnums=(1, 2, 3)),
+                kind="paged_decode", bucket=width,
+                model_id=self.model_id,
+            )
+            self._paged_decode[width] = fn
+            self._count("paged_decode")
         return fn

@@ -120,6 +120,14 @@ _FAMILY_HELP: dict[str, str] = {
     "serving_prefill_seconds": "per-request slot prefill (admission) time",
     "serving_queue_wait_seconds": "generation queue wait before a slot",
     "serving_batch_occupancy": "live slots per decode step",
+    # paged KV cache (docs/SERVING.md): block tables + prefix sharing
+    "serving_prefix_lookups_total": (
+        "prompt-prefix cache lookups at admission, by model and outcome"
+    ),
+    "serving_prefix_tokens_saved_total": (
+        "prompt tokens NOT re-prefilled thanks to prefix hits, by model"
+    ),
+    "serving_blocks_per_request": "KV pool blocks held per admitted request",
     # observability engine (telemetry/{profiler,recorder,slo}.py)
     "profiler_compile_seconds": "jitted-program calls that compiled, by kind",
     "profiler_execute_seconds": "jitted-program steady-state calls, by kind",

@@ -48,6 +48,16 @@ def enabled() -> bool:
     return os.environ.get("PYGRID_PROFILER", "").lower() not in ("off", "0")
 
 
+def cost_enabled() -> bool:
+    """XLA cost attribution off-switch (``PYGRID_PROFILER_COST=off``):
+    the analysis re-lowers each program once from captured avals — a
+    trace, not an execution, but still work an operator may not want on
+    a loaded node's telemetry endpoint."""
+    return enabled() and os.environ.get(
+        "PYGRID_PROFILER_COST", ""
+    ).lower() not in ("off", "0")
+
+
 class JitSiteProfiler:
     """Registry of jitted-program callsites and their timing splits.
 
@@ -59,6 +69,12 @@ class JitSiteProfiler:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._programs: dict[tuple, dict] = {}
+        #: program key -> (jitted fn, arg avals) captured at first call,
+        #: for lazy XLA cost attribution (flops / bytes accessed); avals
+        #: are ShapeDtypeStructs — metadata only, never buffer refs, so
+        #: donated arguments are not pinned or touched
+        self._cost_src: dict[tuple, tuple] = {}
+        self._cost: dict[tuple, dict | None] = {}
 
     def wrap(
         self,
@@ -96,6 +112,11 @@ class JitSiteProfiler:
         seen = {"traces": 0, "calls": 0}
 
         def wrapped(*args: Any, **kwargs: Any):
+            if seen["calls"] == 0 and hasattr(fn, "lower"):
+                # capture arg AVALS (shape/dtype only) BEFORE the first
+                # call — afterwards donated buffers may be consumed and
+                # even metadata reads would race the donation
+                self._capture_avals(key, fn, args, kwargs)
             t0 = time.perf_counter()
             out = fn(*args, **kwargs)
             dt = time.perf_counter() - t0
@@ -126,38 +147,131 @@ class JitSiteProfiler:
         wrapped.__wrapped__ = fn
         return wrapped
 
-    def snapshot(self) -> list[dict]:
-        """Per-program rows for ``GET /telemetry/programs``: program
-        key, bucket, compile ms, hit count, execute-time split."""
+    def _capture_avals(self, key: tuple, fn, args, kwargs) -> None:
+        """Shape/dtype skeleton of a program's first-call arguments —
+        enough to re-``lower`` it later for cost analysis without
+        holding (or ever having held) the real buffers."""
+        if not cost_enabled():
+            return
+        try:
+            import jax
+
+            def _aval(a):
+                if hasattr(a, "shape") and hasattr(a, "dtype"):
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+                return a  # static leaf (python scalar) — pass through
+
+            avals = jax.tree_util.tree_map(_aval, (args, kwargs))
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            return
         with self._lock:
-            rows = [dict(e) for e in self._programs.values()]
-        out = []
-        for e in sorted(
-            rows, key=lambda r: (r["model"], r["kind"], r["bucket"])
-        ):
-            hits = e["hits"]
-            out.append(
-                {
-                    "program": f"{e['kind']}/{e['bucket']}",
-                    "model": e["model"],
-                    "kind": e["kind"],
-                    "bucket": e["bucket"],
-                    "compiles": e["compiles"],
-                    "compile_ms": round(e["compile_s"] * 1e3, 3),
-                    "hits": hits,
-                    "execute_ms_total": round(e["execute_s"] * 1e3, 3),
-                    "execute_ms_mean": round(
-                        e["execute_s"] * 1e3 / hits, 4
-                    )
-                    if hits
+            self._cost_src.setdefault(key, (fn, avals))
+
+    def _cost_for(self, key: tuple) -> dict | None:
+        """Lazy per-program XLA cost analysis (flops / bytes accessed),
+        computed ONCE per program from the captured avals and cached.
+        Prefers ``Lowered.cost_analysis()`` (an HLO-level estimate — a
+        trace, no backend compile); falls back to
+        ``Compiled.cost_analysis()`` where the lowered hook is missing.
+        None when unavailable (non-jitted wrappers, disabled knob)."""
+        with self._lock:
+            if key in self._cost:
+                return self._cost[key]
+            src = self._cost_src.get(key)
+        if src is None or not cost_enabled():
+            return None
+        result: dict | None = None
+        try:
+            fn, (args, kwargs) = src
+            lowered = fn.lower(*args, **kwargs)
+            try:
+                analysis = lowered.cost_analysis()
+            except Exception:  # noqa: BLE001 — hook varies by jax version
+                analysis = None
+            if not analysis:
+                analysis = lowered.compile().cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0] if analysis else None
+            if isinstance(analysis, dict):
+                flops = analysis.get("flops")
+                nbytes = analysis.get("bytes accessed")
+                result = {
+                    "flops": float(flops) if flops is not None else None,
+                    "bytes_accessed": float(nbytes)
+                    if nbytes is not None
                     else None,
                 }
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            result = None
+        with self._lock:
+            self._cost[key] = result
+        return result
+
+    def snapshot(self, include_cost: bool = False) -> list[dict]:
+        """Per-program rows for ``GET /telemetry/programs``: program
+        key, bucket, compile ms, hit count, execute-time split — plus,
+        with ``include_cost``, the program's XLA cost analysis (flops /
+        bytes accessed per call and totals over its call count), and
+        rows RANKED by total bytes accessed so the heaviest device
+        pressure sorts first (wall-clock alone hides a cheap-to-dispatch
+        but bandwidth-hungry program)."""
+        with self._lock:
+            rows = [
+                (key, dict(e)) for key, e in self._programs.items()
+            ]
+        out = []
+        for key, e in rows:
+            hits = e["hits"]
+            row = {
+                "program": f"{e['kind']}/{e['bucket']}",
+                "model": e["model"],
+                "kind": e["kind"],
+                "bucket": e["bucket"],
+                "compiles": e["compiles"],
+                "compile_ms": round(e["compile_s"] * 1e3, 3),
+                "hits": hits,
+                "execute_ms_total": round(e["execute_s"] * 1e3, 3),
+                "execute_ms_mean": round(
+                    e["execute_s"] * 1e3 / hits, 4
+                )
+                if hits
+                else None,
+            }
+            if include_cost:
+                cost = self._cost_for(key)
+                calls = hits + e["compiles"]
+                row["flops"] = cost["flops"] if cost else None
+                row["bytes_accessed"] = (
+                    cost["bytes_accessed"] if cost else None
+                )
+                row["bytes_accessed_total"] = (
+                    cost["bytes_accessed"] * calls
+                    if cost and cost["bytes_accessed"] is not None
+                    else None
+                )
+                row["flops_total"] = (
+                    cost["flops"] * calls
+                    if cost and cost["flops"] is not None
+                    else None
+                )
+            out.append(row)
+        if include_cost:
+            return sorted(
+                out,
+                key=lambda r: (
+                    -(r.get("bytes_accessed_total") or 0.0),
+                    r["model"], r["kind"], r["bucket"],
+                ),
             )
-        return out
+        return sorted(
+            out, key=lambda r: (r["model"], r["kind"], r["bucket"])
+        )
 
     def reset(self) -> None:
         with self._lock:
             self._programs.clear()
+            self._cost_src.clear()
+            self._cost.clear()
 
 
 class DeviceMemorySampler:

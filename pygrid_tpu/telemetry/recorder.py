@@ -238,11 +238,25 @@ class FlightRecorder:
         )
         path = os.path.join(directory, name)
         try:
-            with open(path, "w", encoding="utf-8") as fh:
+            # write-then-rename: a dump must appear ATOMICALLY — dump
+            # consumers (and the tests) poll the directory and read as
+            # soon as the name shows up, so an in-progress write must
+            # not be observable as an empty/truncated JSON file. The
+            # temp name is opaque (no reason slug, hidden) so no
+            # directory poll can match it mid-write.
+            tmp_path = os.path.join(directory, f".flight-{seq:06d}.tmp")
+            with open(tmp_path, "w", encoding="utf-8") as fh:
                 # default=repr: one unserializable leaf must not lose
                 # the dump
                 json.dump(payload, fh, indent=1, default=repr)
+            os.replace(tmp_path, path)
         except BaseException:
+            try:
+                # a failed write must not strand its temp file in the
+                # operator's dump directory (crash-looping full disks)
+                os.unlink(tmp_path)
+            except OSError:
+                pass
             with self._lock:
                 # roll back the reservation: nothing was captured, so
                 # the next attempt must not be rate-limited away

@@ -37,6 +37,52 @@ def default_data_fn(batch_size: int, features: int = 784, classes: int = 10):
     return X, y
 
 
+class AggregatorSelector:
+    """Per-cycle placement policy for hierarchical aggregation
+    (PR-6 follow-up): the worker RE-polls placement every cycle — a
+    fresh lookup, never a cached address — and remembers sub-aggregators
+    whose report fell back direct, skipping them for a cooldown window
+    (``PYGRID_AGG_RETRY_COOLDOWN_S``, default 30 s ≈ 2× the registry
+    TTL). Without the cooldown, a dead-but-not-yet-expired subagg that
+    placement keeps returning poisons every subsequent round with a
+    connect timeout before the direct fallback; without the re-poll, a
+    subagg that died AND expired would still be dialed forever."""
+
+    def __init__(self, cooldown_s: float | None = None) -> None:
+        import os
+
+        if cooldown_s is None:
+            try:
+                cooldown_s = float(
+                    os.environ.get("PYGRID_AGG_RETRY_COOLDOWN_S", "")
+                )
+            except (TypeError, ValueError):
+                cooldown_s = 30.0
+        self.cooldown_s = cooldown_s
+        self._failed: dict[str, float] = {}  # addr -> monotonic failure time
+
+    def choose(self, addr: str | None, now: float | None = None) -> str | None:
+        """Filter one freshly-polled placement answer: a recently-failed
+        address reports direct-to-node instead until its cooldown
+        expires (expired entries are pruned — the subagg may be back)."""
+        import time as _time
+
+        if addr is None:
+            return None
+        now = _time.monotonic() if now is None else now
+        failed_at = self._failed.get(addr)
+        if failed_at is not None:
+            if now - failed_at < self.cooldown_s:
+                return None
+            del self._failed[addr]
+        return addr
+
+    def mark_failed(self, addr: str, now: float | None = None) -> None:
+        import time as _time
+
+        self._failed[addr] = _time.monotonic() if now is None else now
+
+
 def lookup_aggregator(
     network_url: str, node_url: str, worker_id: str
 ) -> str | None:
@@ -83,18 +129,27 @@ def run_worker(
     ``max_retry_wait``) before the next request. ``wire="binary"`` switches
     the event transport to msgpack frames with raw/bf16 diff payloads.
     ``network_url`` opts into hierarchical aggregation: before each
-    report the worker asks the network's placement for its
-    sub-aggregator (docs/AGGREGATION.md) and falls back to a direct
-    node report when none is live."""
+    report the worker RE-polls the network's placement for its
+    sub-aggregator (docs/AGGREGATION.md) — never a cached address, so a
+    placement change between cycles is honored — falls back to a direct
+    node report when none is live, and remembers a failed sub-aggregator
+    for a cooldown window so a dead-but-unexpired subagg cannot poison
+    every subsequent round (:class:`AggregatorSelector`)."""
     import time
 
     from pygrid_tpu.client.fl_client import FLClient
 
     result = WorkerResult()
     client = FLClient(node_url, auth_token=auth_token, wire=wire)
+    selector = AggregatorSelector()
     try:
         for _ in range(cycles):
             retry_wait = [0.0]
+            # placement is per-cycle state: drop the previous cycle's
+            # answer so a sparse/compressed cycle (which must report
+            # direct) can never inherit a stale subagg address
+            client.aggregator_url = None
+            assigned = [None]
             job = client.new_job(model_name, model_version)
             job.diff_precision = diff_precision
             job.diff_compression = diff_compression
@@ -107,9 +162,12 @@ def run_worker(
                 ):
                     # sparse (top-k) diffs skip the tree: a sub-
                     # aggregator folds dense payloads only
-                    client.aggregator_url = lookup_aggregator(
-                        network_url, node_url, job.worker_id
+                    assigned[0] = selector.choose(
+                        lookup_aggregator(
+                            network_url, node_url, job.worker_id
+                        )
                     )
+                    client.aggregator_url = assigned[0]
                 plan = job.plans["training_plan"]
                 params = job.model_params
                 cfg = job.client_config or {}
@@ -149,6 +207,11 @@ def run_worker(
             job.add_listener(job.EVENT_REJECTED, on_rejected)
             job.add_listener(job.EVENT_ERROR, on_error)
             job.start()
+            if assigned[0] and client.aggregator_url is None:
+                # the client cleared the address mid-report: the subagg
+                # was unreachable/refusing and the report fell back
+                # direct — cool this address down before re-dialing it
+                selector.mark_failed(assigned[0])
             if retry_wait[0]:
                 time.sleep(retry_wait[0])
     finally:

@@ -1,9 +1,10 @@
 #!/usr/bin/env sh
 # Serving-path smoke: tiny transformer, CPU only, no sockets — catches
-# continuous-batching throughput and recompile regressions in seconds,
-# without a TPU or a live node. The same assertions run under tier-1 via
-# tests/unit/test_bench_serving.py; the full-size capture is bench.py's
-# bench_serving() section (recorded into the round's BENCH file).
+# continuous-batching throughput, paged-KV capacity, prefix-cache and
+# recompile regressions in seconds, without a TPU or a live node. The
+# same assertions run under tier-1 via tests/unit/test_bench_serving.py;
+# the full-size captures are bench.py's bench_serving() and
+# bench_serving_paged() sections (recorded into the round's BENCH file).
 #
 # Usage: scripts/bench_serving.sh [--full]
 set -e
@@ -12,6 +13,8 @@ TINY=True
 [ "$1" = "--full" ] && TINY=False
 JAX_PLATFORMS=cpu python -c "
 import json
-from bench import bench_serving
-print(json.dumps(bench_serving(tiny=$TINY), indent=2))
+from bench import bench_serving, bench_serving_paged
+out = bench_serving(tiny=$TINY)
+out.update(bench_serving_paged(tiny=$TINY))
+print(json.dumps(out, indent=2))
 "

@@ -13,7 +13,28 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 
-from bench import bench_serving  # noqa: E402
+from bench import bench_serving, bench_serving_paged  # noqa: E402
+
+
+def test_serving_paged_bench_capacity_and_prefix_hits():
+    """The paged-KV tentpole gate (scripts/bench_serving.sh --paged's
+    twin): ≥3× concurrent-request capacity per GB of cache vs the
+    contiguous-slot baseline at EQUAL byte budgets and bit-identical
+    greedy outputs (asserted inside the bench), shared-prefix traffic
+    actually skipping prefill work (hit counters), zero recompiles
+    under shape + prefix variety."""
+    out = bench_serving_paged(tiny=True)
+    assert out["paged_capacity_ratio"] >= 3.0, out
+    assert (
+        out["paged_requests_per_gb"]
+        >= 3.0 * out["contig_requests_per_gb"]
+    ), out
+    assert out["paged_recompiles_under_traffic"] == 0, out
+    # all but the first shared-prefix request hit the prefix cache...
+    assert out["paged_prefix_hits"] >= 7, out
+    # ...and the hits really saved prefill work (whole shared pages)
+    assert out["paged_prefix_tokens_saved"] >= 7 * 32, out
+    assert out["paged_prefix_prefill_saved_pct"] > 50.0, out
 
 
 def test_serving_bench_smoke_throughput_and_compiles():

@@ -144,6 +144,123 @@ class TestGL1:
         """, TraceSafetyChecker)
         assert _codes(res) == ["GL103", "GL103"]
 
+    def test_donation_after_use_fires_GL104(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            fn = jax.jit(lambda p, b: (p, b + 1), donate_argnums=(1,))
+
+            def drive(params, buf):
+                out = fn(params, buf)
+                return buf + out  # read of a consumed buffer
+        """, TraceSafetyChecker)
+        assert _codes(res) == ["GL104"]
+
+    def test_donation_of_attribute_chain_fires_GL104(self, tmp_path):
+        """The engine idiom's failure mode: a wrapped donating jit
+        consumes ``self._k`` and a LATER statement still reads it."""
+        res = _lint(tmp_path, """
+            import jax
+            from pygrid_tpu import telemetry
+
+            step = telemetry.profiler.wrap(
+                jax.jit(lambda p, k, v: (k, v), donate_argnums=(1, 2)),
+                kind="decode",
+            )
+
+            class Engine:
+                def loop(self):
+                    toks = step(self.params, self._k, self._v)
+                    return self._k.shape  # consumed by the call above
+        """, TraceSafetyChecker)
+        assert _codes(res) == ["GL104"]
+
+    def test_same_statement_reassignment_is_quiet(self, tmp_path):
+        """The paged engine's swap discipline: the donated names are
+        reassigned by the donating call's own tuple unpack."""
+        res = _lint(tmp_path, """
+            import jax
+
+            fn = jax.jit(
+                lambda p, k, v, pos: (1, k, v, pos), donate_argnums=(1, 2, 3)
+            )
+
+            class Engine:
+                def step(self):
+                    toks, self._k, self._v, self._pos = fn(
+                        self.params, self._k, self._v, self._pos
+                    )
+                    return toks, self._k.shape  # revived — fine
+        """, TraceSafetyChecker)
+        assert res.failures == []
+
+    def test_reassignment_before_read_is_quiet_GL104(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            fn = jax.jit(lambda p, b: b + 1, donate_argnums=(1,))
+
+            def drive(params, buf):
+                out = fn(params, buf)
+                buf = out
+                return buf  # reassigned first
+        """, TraceSafetyChecker)
+        assert res.failures == []
+
+    def test_undonated_positions_are_quiet_GL104(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            fn = jax.jit(lambda p, b: b + 1, donate_argnums=(1,))
+
+            def drive(params, buf):
+                out = fn(params, buf)
+                return params  # position 0 was NOT donated
+        """, TraceSafetyChecker)
+        assert res.failures == []
+
+    def test_immediately_invoked_donating_jit_fires_GL104(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def drive(step, params, buf):
+                out = jax.jit(step, donate_argnums=(1,))(params, buf)
+                return buf.sum()
+        """, TraceSafetyChecker)
+        # GL103 (jit-per-call) fires on the same line by design
+        assert "GL104" in _codes(res)
+
+    def test_deferred_lambda_call_does_not_kill_GL104(self, tmp_path):
+        """A donating call inside a lambda/callback does NOT run at its
+        statement's line — later reads of the would-be-donated name are
+        legitimate (the 'errs quiet, not wrong' contract)."""
+        res = _lint(tmp_path, """
+            import jax
+
+            fn = jax.jit(lambda p, b: b + 1, donate_argnums=(1,))
+
+            def schedule(callbacks, params, buf):
+                callbacks.append(lambda: fn(params, buf))
+                return buf.sum()  # fn was never called here
+        """, TraceSafetyChecker)
+        assert "GL104" not in _codes(res)
+
+    def test_branch_reassignment_revives_GL104(self, tmp_path):
+        """A nested-body assignment revives the name — the rule errs
+        quiet on branchy control flow rather than false-positive."""
+        res = _lint(tmp_path, """
+            import jax
+
+            fn = jax.jit(lambda p, b: b + 1, donate_argnums=(1,))
+
+            def drive(params, buf, flag):
+                out = fn(params, buf)
+                if flag:
+                    buf = out
+                return buf
+        """, TraceSafetyChecker)
+        assert res.failures == []
+
     def test_clean_jitted_function_is_quiet(self, tmp_path):
         res = _lint(tmp_path, """
             import jax

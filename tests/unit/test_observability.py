@@ -96,6 +96,70 @@ def test_wrap_disabled_is_identity(monkeypatch):
     assert profiler.wrap(fn, kind="decode", bucket=1) is fn
 
 
+def test_snapshot_cost_attribution_for_jitted_program():
+    """XLA cost attribution: a REAL jitted program's snapshot row gains
+    flops / bytes-accessed (from avals captured at first call — never
+    the buffers themselves) and rows rank by total bytes accessed."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = profiler.wrap(
+        jax.jit(lambda a, b: a @ b, donate_argnums=(0,)),
+        kind="decode", bucket=2, model_id="cost",
+    )
+    x = jnp.ones((8, 8), jnp.float32)
+    fn(x, jnp.ones((8, 8), jnp.float32))
+    rows = [
+        r
+        for r in profiler.programs_snapshot(include_cost=True)
+        if r["model"] == "cost"
+    ]
+    (row,) = rows
+    assert row["flops"] and row["flops"] > 0
+    assert row["bytes_accessed"] and row["bytes_accessed"] > 0
+    assert row["bytes_accessed_total"] >= row["bytes_accessed"]
+    # second snapshot serves the cached analysis (no re-lower)
+    (again,) = [
+        r
+        for r in profiler.programs_snapshot(include_cost=True)
+        if r["model"] == "cost"
+    ]
+    assert again["flops"] == row["flops"]
+    # the plain snapshot keeps its stable (model, kind, bucket) order
+    plain = [
+        r for r in profiler.programs_snapshot() if r["model"] == "cost"
+    ]
+    assert "flops" not in plain[0]
+
+
+def test_snapshot_cost_absent_for_non_jitted_wrappers():
+    fn = profiler.wrap(_FakeJitted(), kind="decode", bucket=9, model_id="nc")
+    fn("x")
+    (row,) = [
+        r
+        for r in profiler.programs_snapshot(include_cost=True)
+        if r["model"] == "nc"
+    ]
+    assert row["flops"] is None and row["bytes_accessed"] is None
+
+
+def test_cost_disabled_by_env(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PYGRID_PROFILER_COST", "off")
+    fn = profiler.wrap(
+        jax.jit(lambda a: a + 1), kind="decode", bucket=3, model_id="nc2",
+    )
+    fn(jnp.ones((4,), jnp.float32))
+    (row,) = [
+        r
+        for r in profiler.programs_snapshot(include_cost=True)
+        if r["model"] == "nc2"
+    ]
+    assert row["flops"] is None
+
+
 def test_memory_sampler_shape_on_this_backend():
     # CPU backends report no memory_stats → empty list; an accelerator
     # yields dicts with the three byte gauges. Either way: no raise.

@@ -233,15 +233,16 @@ def test_engine_recovers_after_device_loop_failure(params):
         model_id="boom",
     )
     try:
-        original = eng.programs.prefill
+        original = eng.programs.paged_prefill
 
         def boom(bucket):
             raise RuntimeError("injected device failure")
 
-        eng.programs.prefill = boom
+        # the paged program is the default admission path
+        eng.programs.paged_prefill = boom
         with pytest.raises(E.PyGridError, match="engine error"):
             eng.submit(np.array([[1, 2]]), 2, timeout=30)
-        eng.programs.prefill = original
+        eng.programs.paged_prefill = original
         got = eng.submit(np.array([[1, 2]]), 2, timeout=60)
         np.testing.assert_array_equal(got, _ref(params, [[1, 2]], 2))
     finally:

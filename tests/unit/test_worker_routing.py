@@ -1,0 +1,128 @@
+"""Worker-side aggregation placement policy (PR-6 follow-up).
+
+The contracts: placement is RE-polled every cycle (``run_worker`` resets
+``client.aggregator_url`` before each job — a dead subagg can't be
+inherited from an earlier round), and a sub-aggregator whose report fell
+back direct is skipped for a cooldown window instead of being re-dialed
+while the registry TTL still advertises it.
+"""
+
+from __future__ import annotations
+
+from pygrid_tpu.worker import AggregatorSelector
+
+
+def test_choose_passes_fresh_address_through():
+    sel = AggregatorSelector(cooldown_s=30.0)
+    assert sel.choose("http://subagg-1", now=100.0) == "http://subagg-1"
+    assert sel.choose(None, now=100.0) is None
+
+
+def test_failed_address_cools_down_then_recovers():
+    sel = AggregatorSelector(cooldown_s=30.0)
+    sel.mark_failed("http://subagg-1", now=100.0)
+    # within the cooldown: placement still returns the dead subagg (TTL
+    # hasn't expired it yet) but the worker reports direct instead
+    assert sel.choose("http://subagg-1", now=110.0) is None
+    assert sel.choose("http://subagg-1", now=129.9) is None
+    # a DIFFERENT subagg is unaffected
+    assert sel.choose("http://subagg-2", now=110.0) == "http://subagg-2"
+    # past the cooldown the address is retried (and pruned)
+    assert sel.choose("http://subagg-1", now=130.1) == "http://subagg-1"
+    assert sel.choose("http://subagg-1", now=131.0) == "http://subagg-1"
+
+
+def test_cooldown_env_knob_fallback(monkeypatch):
+    monkeypatch.setenv("PYGRID_AGG_RETRY_COOLDOWN_S", "5")
+    assert AggregatorSelector().cooldown_s == 5.0
+    monkeypatch.setenv("PYGRID_AGG_RETRY_COOLDOWN_S", "not-a-number")
+    assert AggregatorSelector().cooldown_s == 30.0  # never bricks
+
+
+def test_report_redials_when_placement_changes(monkeypatch):
+    """A cached sub-aggregator socket is only reused while placement
+    still names the SAME address: re-assignment between cycles must
+    close the old socket and dial the new one, or reports keep landing
+    on the previous (possibly dead) sub-aggregator."""
+    from pygrid_tpu.client.fl_client import FLClient
+
+    dialed: list[str] = []
+    closed: list[str] = []
+
+    class _FakeWS:
+        def __init__(self, url, **kw) -> None:
+            self.url = url
+            dialed.append(url)
+
+        def send_msg_binary(self, *a, **kw):
+            return {"data": {"status": "ok", "via": self.url}}
+
+        def close(self):
+            closed.append(self.url)
+
+    monkeypatch.setattr(
+        "pygrid_tpu.client.fl_client.GridWSClient", _FakeWS
+    )
+    client = FLClient.__new__(FLClient)
+    client.aggregator_url = "ws://subagg-a"
+    client._agg_ws = None
+    client._agg_ws_url = None
+    client._timeout = 5
+
+    out = client._report_via_aggregator("w1", "key", b"diff", "m")
+    assert out["via"] == "ws://subagg-a"
+    # same placement: the socket is reused, no extra dial
+    client._report_via_aggregator("w1", "key", b"diff", "m")
+    assert dialed == ["ws://subagg-a"]
+    # placement re-assigns: old socket closed, new address dialed
+    client.aggregator_url = "ws://subagg-b"
+    out = client._report_via_aggregator("w1", "key", b"diff", "m")
+    assert out["via"] == "ws://subagg-b"
+    assert dialed == ["ws://subagg-a", "ws://subagg-b"]
+    assert closed == ["ws://subagg-a"]
+
+
+def test_run_worker_resets_aggregator_url_each_cycle(monkeypatch):
+    """A compressed/sparse cycle must never inherit the previous
+    cycle's subagg address: run_worker nulls ``client.aggregator_url``
+    at cycle start, so only an explicit per-cycle placement sets it."""
+    from pygrid_tpu import worker as W
+
+    events: list = []
+
+    class _FakeJob:
+        EVENT_ACCEPTED = "accepted"
+        EVENT_REJECTED = "rejected"
+        EVENT_ERROR = "error"
+
+        def __init__(self) -> None:
+            self.listeners: dict = {}
+            self.diff_precision = None
+            self.diff_compression = None
+
+        def add_listener(self, name, fn):
+            self.listeners[name] = fn
+
+        def start(self):
+            events.append("start")
+
+    class _FakeClient:
+        def __init__(self, *a, **kw) -> None:
+            # simulate a stale address left over from "last run"
+            self.aggregator_url = "http://stale-subagg"
+
+        def new_job(self, *a, **kw):
+            events.append(("url-at-new-job", self.aggregator_url))
+            return _FakeJob()
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(
+        "pygrid_tpu.client.fl_client.FLClient", _FakeClient
+    )
+    W.run_worker("http://node", "model", cycles=2)
+    assert events == [
+        ("url-at-new-job", None), "start",
+        ("url-at-new-job", None), "start",
+    ]
