@@ -9,12 +9,14 @@ from pygrid_tpu.analysis.checkers.gl1_trace import TraceSafetyChecker
 from pygrid_tpu.analysis.checkers.gl2_locks import LockDisciplineChecker
 from pygrid_tpu.analysis.checkers.gl3_async import AsyncHygieneChecker
 from pygrid_tpu.analysis.checkers.gl4_contracts import ContractDriftChecker
+from pygrid_tpu.analysis.checkers.gl5_pallas import PallasBoundsChecker
 
 ALL_CHECKERS = (
     TraceSafetyChecker,
     LockDisciplineChecker,
     AsyncHygieneChecker,
     ContractDriftChecker,
+    PallasBoundsChecker,
 )
 
 __all__ = [
@@ -22,5 +24,6 @@ __all__ = [
     "AsyncHygieneChecker",
     "ContractDriftChecker",
     "LockDisciplineChecker",
+    "PallasBoundsChecker",
     "TraceSafetyChecker",
 ]

@@ -250,14 +250,14 @@ class _TraceBodyScan(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _donate_positions(call: ast.Call) -> tuple[int, ...] | None:
-    """Donated argnums if ``call`` is ``jit/pjit(..., donate_argnums=…)``
-    with literal positions; None otherwise (dynamic positions are out of
-    reach for a static rule — stay quiet, not wrong)."""
+def _literal_argnums(call: ast.Call, kwname: str) -> tuple[int, ...] | None:
+    """Literal argnum positions of ``kwname`` on a ``jit/pjit(...)``
+    call; None otherwise (dynamic positions are out of reach for a
+    static rule — stay quiet, not wrong)."""
     if not isinstance(call, ast.Call) or not _is_jit_callable(call.func):
         return None
     for kw in call.keywords:
-        if kw.arg != "donate_argnums":
+        if kw.arg != kwname:
             continue
         v = kw.value
         if isinstance(v, ast.Constant) and isinstance(v.value, int):
@@ -274,6 +274,12 @@ def _donate_positions(call: ast.Call) -> tuple[int, ...] | None:
             return tuple(out)
         return None
     return None
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """Donated argnums if ``call`` is ``jit/pjit(..., donate_argnums=…)``
+    with literal positions."""
+    return _literal_argnums(call, "donate_argnums")
 
 
 def _find_donating_jit(expr: ast.AST) -> tuple[int, ...] | None:
@@ -455,6 +461,291 @@ class _DonationChecker:
                 dead.pop(name, None)
 
 
+#: receivers whose subscripts / ``.get()`` reads carry PER-REQUEST data
+#: (wire frames, JSON bodies, HTTP requests) — the taint sources GL105
+#: follows into jit constructions
+_REQUEST_NAMES = {
+    "msg", "message", "payload", "request", "req", "body", "data",
+    "query",
+}
+#: ``request.<attr>`` reads that ARE the request payload
+_REQUEST_ATTRS = {"json", "query", "match_info", "rel_url", "post"}
+
+
+class _ScalarTaintChecker:
+    """GL105 — python-scalar-into-traced-signature.
+
+    The ``n_new`` pathology PR 3 fixed: a host int read from a request
+    (``int(data["n_new"])``) baked into a jitted program's STATIC
+    surface — a lambda default / closure (``jax.jit(lambda p, x,
+    n=n_new: ...)``) or a ``static_argnums`` position — compiles one
+    XLA program per distinct client value. Light per-scope dataflow:
+    names assigned from request/JSON reads (subscripts or ``.get()`` of
+    request-ish receivers, ``request.json``, ``json.loads``, arithmetic
+    or ``int()``/``float()`` over those) are tainted; a finding fires
+    when a tainted name
+
+    1. appears anywhere inside a ``jit(...)``/``pjit(...)``
+       CONSTRUCTION expression (lambda default, ``partial`` binding —
+       the closure-bake idiom),
+    2. is a free variable of a same-scope ``def`` passed to ``jit`` by
+       name, or
+    3. is passed at a literal ``static_argnums`` position of a
+       jit-built callable.
+
+    Passing the scalar as a TRACED argument (or wrapping it
+    ``jnp.int32(...)``) is the fix and stays quiet — traced values
+    cannot force a retrace."""
+
+    def __init__(self, mod: ModuleContext) -> None:
+        self.mod = mod
+        self.findings: list[Finding] = []
+        #: call nodes already reported — _body walks a compound
+        #: statement's whole subtree for sinks AND recurses into its
+        #: nested bodies, so a sink inside an if/try would otherwise
+        #: report once per nesting level
+        self._seen_sinks: set[int] = set()
+
+    def run(self) -> list[Finding]:
+        self._scope(self.mod.tree.body)
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scope(node.body)
+        return self.findings
+
+    # ── taint sources ────────────────────────────────────────────────
+
+    @staticmethod
+    def _root(node: ast.AST) -> str | None:
+        while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+            node = node.func if isinstance(node, ast.Call) else node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def _is_source(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Subscript):
+            return self._root(node.value) in _REQUEST_NAMES
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "get":
+                return self._root(f.value) in _REQUEST_NAMES
+            if _dotted(f) == "json.loads":
+                return True
+        if isinstance(node, ast.Attribute):
+            return (
+                self._root(node.value) in ("request", "req")
+                and node.attr in _REQUEST_ATTRS
+            )
+        return False
+
+    def _tainted(self, expr: ast.AST, taint: set[str]) -> bool:
+        for node in ast.walk(expr):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in taint
+            ):
+                return True
+            if self._is_source(node):
+                return True
+        return False
+
+    # ── scope walk ───────────────────────────────────────────────────
+
+    def _scope(self, stmts: list[ast.stmt]) -> None:
+        taint: set[str] = set()
+        local_defs: dict[str, ast.AST] = {}
+        static_jits: dict[str, tuple[int, ...]] = {}
+        self._body(stmts, taint, local_defs, static_jits)
+
+    @staticmethod
+    def _walk_same_scope(node: ast.AST):
+        """``ast.walk`` minus nested def/lambda subtrees: their assigns
+        bind THEIR scope, not this one — letting them leak into the
+        enclosing taint set produced confirmed false positives."""
+        stack: list[ast.AST] = [node]
+        while stack:
+            cur = stack.pop()
+            yield cur
+            for child in ast.iter_child_nodes(cur):
+                if isinstance(
+                    child,
+                    (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef),
+                ):
+                    continue
+                stack.append(child)
+
+    def _assigns(
+        self,
+        stmt: ast.stmt,
+        taint: set[str],
+        static_jits: dict[str, tuple[int, ...]],
+    ) -> None:
+        for node in self._walk_same_scope(stmt):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if not isinstance(target, ast.Name):
+                    continue
+                jit_call = next(
+                    (
+                        sub
+                        for sub in ast.walk(node.value)
+                        if isinstance(sub, ast.Call)
+                        and _is_jit_callable(sub.func)
+                    ),
+                    None,
+                )
+                if jit_call is not None:
+                    positions = _literal_argnums(
+                        jit_call, "static_argnums"
+                    )
+                    if positions:
+                        static_jits[target.id] = positions
+                if self._tainted(node.value, taint):
+                    taint.add(target.id)
+                else:
+                    taint.discard(target.id)
+
+    def _body(
+        self,
+        stmts: list[ast.stmt],
+        taint: set[str],
+        local_defs: dict[str, ast.AST],
+        static_jits: dict[str, tuple[int, ...]],
+    ) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[stmt.name] = stmt
+                continue  # nested scopes get their own walk
+            nested = [
+                sub
+                for attr in ("body", "orelse", "finalbody")
+                for sub in (getattr(stmt, attr, None) or [])
+                if isinstance(sub, ast.stmt)
+            ] or list(getattr(stmt, "handlers", []) or [])
+            if nested:
+                # compound statement: only the HEADER expressions run at
+                # this point in the statement order — sinks and assigns
+                # inside the bodies are handled by the recursion below,
+                # in their own order (an assign after a sink must not
+                # retroactively taint it)
+                for attr in ("test", "iter", "items"):
+                    header = getattr(stmt, attr, None)
+                    for part in header if isinstance(header, list) else (
+                        [header] if header is not None else []
+                    ):
+                        self._sinks(part, taint, local_defs, static_jits)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if isinstance(sub, list) and sub and isinstance(
+                        sub[0], ast.stmt
+                    ):
+                        self._body(sub, taint, local_defs, static_jits)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    self._body(
+                        handler.body, taint, local_defs, static_jits
+                    )
+                continue
+            self._sinks(stmt, taint, local_defs, static_jits)
+            self._assigns(stmt, taint, static_jits)
+
+    # ── sinks ────────────────────────────────────────────────────────
+
+    def _free_reads(self, fn: ast.AST, taint: set[str]) -> bool:
+        """Does ``fn``'s body read a tainted name that is neither a
+        parameter nor assigned locally?"""
+        bound: set[str] = set()
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in (
+                list(args.args)
+                + list(getattr(args, "posonlyargs", []))
+                + list(args.kwonlyargs)
+            ):
+                bound.add(a.arg)
+            for extra in (args.vararg, args.kwarg):
+                if extra is not None:
+                    bound.add(extra.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                bound.add(node.id)
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in taint
+                and node.id not in bound
+            ):
+                return True
+        return False
+
+    def _sinks(
+        self,
+        stmt: ast.AST,
+        taint: set[str],
+        local_defs: dict[str, ast.AST],
+        static_jits: dict[str, tuple[int, ...]],
+    ) -> None:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call) or id(node) in self._seen_sinks:
+                continue
+            if _is_jit_callable(node.func):
+                # sink 1: tainted name anywhere in the construction
+                # (lambda defaults, partial bindings, closure captures)
+                hit = any(
+                    self._tainted(arg, taint)
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]
+                )
+                # sink 2: jit(name) of a same-scope def with a tainted
+                # free variable
+                if not hit and node.args:
+                    target = node.args[0]
+                    name = target.id if isinstance(
+                        target, ast.Name
+                    ) else None
+                    fn = local_defs.get(name or "")
+                    if fn is not None and self._free_reads(fn, taint):
+                        hit = True
+                if hit:
+                    self._seen_sinks.add(id(node))
+                    self.findings.append(
+                        self.mod.finding(
+                            "GL105",
+                            node,
+                            "request-derived host scalar baked into a "
+                            "jitted program's static surface — one "
+                            "compile per distinct client value; pass "
+                            "it as a traced argument or keep it a "
+                            "host-side loop bound",
+                        )
+                    )
+                continue
+            # sink 3: tainted value at a static_argnums position
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            positions = static_jits.get(fname or "")
+            if not positions:
+                continue
+            for i in positions:
+                if 0 <= i < len(node.args) and self._tainted(
+                    node.args[i], taint
+                ):
+                    self._seen_sinks.add(id(node))
+                    self.findings.append(
+                        self.mod.finding(
+                            "GL105",
+                            node,
+                            f"request-derived host scalar passed at "
+                            f"static_argnums position {i} — one "
+                            "compile per distinct client value; make "
+                            "the argument traced or bucket it",
+                        )
+                    )
+                    break
+
+
 class TraceSafetyChecker(Checker):
     name = "GL1"
     description = "host side-effects / recompile hazards under jax.jit"
@@ -464,6 +755,8 @@ class TraceSafetyChecker(Checker):
         "GL103": "jit-per-call / jit-in-loop recompile hazard",
         "GL104": "donated buffer (donate_argnums) read after the jitted "
         "call that consumed it",
+        "GL105": "per-request host scalar baked into a traced program "
+        "signature (one compile per distinct value)",
     }
 
     def __init__(self) -> None:
@@ -592,6 +885,8 @@ class TraceSafetyChecker(Checker):
 
         # GL104: donation-after-use liveness
         findings.extend(_DonationChecker(mod).run())
+        # GL105: request-scalar-into-traced-signature taint
+        findings.extend(_ScalarTaintChecker(mod).run())
         return findings
 
     # ── pass 2: whole-run cross-module reachability ──────────────────────

@@ -1,0 +1,226 @@
+"""GL5 — Pallas grid / BlockSpec bounds.
+
+The failure mode: a ``pl.pallas_call`` whose BlockSpec tiles don't
+divide the operand/output shapes (or whose index_map takes the wrong
+number of grid indices) compiles fine and then reads or writes out of
+bounds at RUNTIME — on TPU often silently, as wrap-around garbage in
+the last tile. ``parallel/pallas_attention.py`` defends with runtime
+asserts and explicit padding (``_pad_to`` up to block multiples); this
+checker moves the shape arithmetic to lint time for every call site
+where the numbers are STATICALLY resolvable (int literals, module-level
+int constants, and ``+ - * // %`` arithmetic over them). Anything
+dynamic — the common case in kernels that pad first — stays quiet:
+the rule errs unreported, not wrong.
+
+- **GL501** — a literal ``out_specs`` BlockSpec block dim does not
+  divide the matching literal ``out_shape`` dim: the grid sweep will
+  address a partial tile past the buffer.
+- **GL502** — a BlockSpec ``index_map`` lambda takes a different number
+  of arguments than the call's ``grid`` has dimensions: Pallas passes
+  one program index per grid axis, so the map either drops an axis or
+  raises at trace time on the device where it first runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from pygrid_tpu.analysis.checkers.gl1_trace import _dotted
+from pygrid_tpu.analysis.core import Checker, Finding, ModuleContext
+
+
+def _ends_with(node: ast.AST, name: str) -> bool:
+    dotted = _dotted(node)
+    return dotted is not None and dotted.split(".")[-1] == name
+
+
+class _ConstTable:
+    """Module-level integer constants (``BLOCK = 128``) for resolving
+    shape arithmetic without executing anything."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.values: dict[str, int] = {}
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+            ):
+                # earlier constants feed later ones (``ROWS = 2 * N``)
+                value = self.resolve(stmt.value)
+                if value is not None:
+                    self.values[stmt.targets[0].id] = value
+
+    def resolve(self, node: ast.AST) -> int | None:
+        """A statically known non-negative int, or None (dynamic)."""
+        if isinstance(node, ast.Constant):
+            return (
+                node.value
+                if isinstance(node.value, int)
+                and not isinstance(node.value, bool)
+                else None
+            )
+        if isinstance(node, ast.Name):
+            return self.values.get(node.id)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            inner = self.resolve(node.operand)
+            return -inner if inner is not None else None
+        if isinstance(node, ast.BinOp):
+            left = self.resolve(node.left)
+            right = self.resolve(node.right)
+            if left is None or right is None:
+                return None
+            try:
+                if isinstance(node.op, ast.Add):
+                    return left + right
+                if isinstance(node.op, ast.Sub):
+                    return left - right
+                if isinstance(node.op, ast.Mult):
+                    return left * right
+                if isinstance(node.op, ast.FloorDiv):
+                    return left // right
+                if isinstance(node.op, ast.Mod):
+                    return left % right
+            except ZeroDivisionError:
+                return None
+        return None
+
+    def resolve_dims(self, node: ast.AST) -> list[int | None] | None:
+        """A tuple/list expression as per-dim ints (None where a dim is
+        dynamic), or None when the expression isn't a tuple at all."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [self.resolve(elt) for elt in node.elts]
+        value = self.resolve(node)
+        return [value] if value is not None else None
+
+
+def _keyword(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _as_list(node: ast.AST | None) -> list[ast.AST]:
+    if node is None:
+        return []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return list(node.elts)
+    return [node]
+
+
+def _block_spec_parts(
+    node: ast.AST,
+) -> tuple[ast.AST | None, ast.Lambda | None] | None:
+    """(block_shape expr, index_map lambda) of a ``BlockSpec(...)``
+    call, or None when ``node`` isn't one (memory-space-only specs and
+    helper wrappers stay out of reach — quiet, not wrong)."""
+    if not (isinstance(node, ast.Call) and _ends_with(node.func, "BlockSpec")):
+        return None
+    shape = node.args[0] if node.args else _keyword(node, "block_shape")
+    index = (
+        node.args[1] if len(node.args) > 1 else _keyword(node, "index_map")
+    )
+    return shape, index if isinstance(index, ast.Lambda) else None
+
+
+def _out_shape_dims(
+    node: ast.AST, consts: _ConstTable
+) -> list[int | None] | None:
+    """Dims of a ``jax.ShapeDtypeStruct((…), dtype)`` literal; None for
+    anything else (helper-built structs are dynamic)."""
+    if isinstance(node, ast.Call) and _ends_with(
+        node.func, "ShapeDtypeStruct"
+    ):
+        shape = node.args[0] if node.args else _keyword(node, "shape")
+        if shape is not None:
+            return consts.resolve_dims(shape)
+    return None
+
+
+class PallasBoundsChecker(Checker):
+    name = "GL5"
+    description = "pallas_call grid / BlockSpec shape bounds"
+    codes = {
+        "GL501": "BlockSpec block shape does not divide the out_shape "
+        "dim it tiles",
+        "GL502": "BlockSpec index_map arity differs from the "
+        "pallas_call grid rank",
+    }
+
+    def check_module(self, mod: ModuleContext) -> Iterable[Finding]:
+        if "pallas_call" not in mod.source:
+            return ()
+        consts = _ConstTable(mod.tree)
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _ends_with(node.func, "pallas_call")
+            ):
+                continue
+            findings.extend(self._check_call(mod, node, consts))
+        return findings
+
+    def _check_call(
+        self, mod: ModuleContext, call: ast.Call, consts: _ConstTable
+    ) -> Iterable[Finding]:
+        grid_expr = _keyword(call, "grid")
+        grid_rank: int | None = None
+        if isinstance(grid_expr, (ast.Tuple, ast.List)):
+            grid_rank = len(grid_expr.elts)
+        elif grid_expr is not None:
+            # a bare int grid is rank 1 whether or not its value
+            # resolves — arity is about SHAPE of the grid, not size
+            grid_rank = 1
+
+        specs = _as_list(_keyword(call, "in_specs")) + _as_list(
+            _keyword(call, "out_specs")
+        )
+        # GL502: every BlockSpec index_map must take one index per
+        # grid axis
+        if grid_rank is not None:
+            for spec in specs:
+                parts = _block_spec_parts(spec)
+                if parts is None or parts[1] is None:
+                    continue
+                arity = len(parts[1].args.args)
+                if arity != grid_rank:
+                    yield mod.finding(
+                        "GL502",
+                        spec,
+                        f"BlockSpec index_map takes {arity} argument(s) "
+                        f"but the pallas_call grid has {grid_rank} "
+                        "dimension(s) — Pallas passes one program index "
+                        "per grid axis",
+                    )
+
+        # GL501: out_specs block dims must divide out_shape dims
+        out_specs = _as_list(_keyword(call, "out_specs"))
+        out_shapes = _as_list(_keyword(call, "out_shape"))
+        if len(out_specs) != len(out_shapes):
+            return
+        for spec, shape in zip(out_specs, out_shapes):
+            parts = _block_spec_parts(spec)
+            if parts is None or parts[0] is None:
+                continue
+            block_dims = consts.resolve_dims(parts[0])
+            shape_dims = _out_shape_dims(shape, consts)
+            if block_dims is None or shape_dims is None:
+                continue
+            if len(block_dims) != len(shape_dims):
+                continue  # rank mismatch is Pallas's own loud error
+            for i, (b, s) in enumerate(zip(block_dims, shape_dims)):
+                if b is None or s is None or b <= 0:
+                    continue
+                if s % b != 0:
+                    yield mod.finding(
+                        "GL501",
+                        spec,
+                        f"BlockSpec block dim {i} is {b} but out_shape "
+                        f"dim {i} is {s} ({s} % {b} != 0) — the last "
+                        "tile reads/writes past the buffer; pad the "
+                        "operand to a block multiple or shrink the "
+                        "block",
+                    )

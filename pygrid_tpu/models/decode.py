@@ -581,6 +581,7 @@ def paged_decode_step(
     token: jax.Array,
     cfg: TransformerConfig = TransformerConfig(),
     compute_dtype: Any | None = None,
+    active: jax.Array | None = None,
 ) -> tuple[jax.Array, PagedKVCache]:
     """One decode step for the first ``w`` slots through their block
     tables — the paged twin of :func:`decode_step_slots`, same contract:
@@ -588,6 +589,14 @@ def paged_decode_step(
     per advanced slot. A free slot inside the width has a zeroed table
     row, so its garbage write lands in trash block 0 — it can never
     corrupt a block that was freed and reallocated to a live request.
+
+    ``active`` ([w] bool, optional) freezes rows mid-batch: a frozen
+    row's k/v write routes to trash block 0 and its ``pos`` does not
+    advance, so the row's cache state is EXACTLY as if the step never
+    ran for it. This is what lets the fused multi-step scan keep
+    stepping a batch after some rows finish (wasted compute, no state
+    damage) — an active row's numerics are untouched by the mask, so
+    the bit-identical-greedy contract survives fusion.
     """
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
@@ -605,6 +614,8 @@ def paged_decode_step(
     page = jnp.minimum(t // block, max_pages - 1)
     blk = jnp.take_along_axis(tw, page[:, None], axis=1)[:, 0]  # [w]
     off = t % block
+    if active is not None:
+        blk = jnp.where(active, blk, 0)  # frozen rows scatter to trash
     h = c(embed[token] + pos_emb[jnp.minimum(t, cfg.max_len - 1)])
     mask = jnp.arange(rows)[None, :] <= t[:, None]  # [w, rows]
     scale = dh**-0.5
@@ -639,8 +650,138 @@ def paged_decode_step(
     logits = jnp.dot(
         c(h), c(embed).T, preferred_element_type=jnp.float32
     )
-    new_pos = cache.pos.at[:w].add(1)
+    advance = (
+        active.astype(jnp.int32) if active is not None
+        else jnp.ones((w,), jnp.int32)
+    )
+    new_pos = cache.pos.at[:w].add(advance)
     return logits, PagedKVCache(k=new_k, v=new_v, pos=new_pos)
+
+
+def paged_verify_chunk(
+    params: Sequence[jax.Array],
+    cache: PagedKVCache,
+    table: jax.Array,
+    tokens: jax.Array,
+    cfg: TransformerConfig = TransformerConfig(),
+    compute_dtype: Any | None = None,
+    active: jax.Array | None = None,
+) -> tuple[jax.Array, PagedKVCache]:
+    """Speculative VERIFY pass: ``K`` consecutive tokens per slot in one
+    wide step through the block tables.
+
+    ``tokens``: [w, K] int32 — slot ``s`` feeds tokens at positions
+    ``pos[s] .. pos[s]+K-1`` (the draft's proposal chain: the slot's
+    last emitted token followed by the first K-1 proposals); their k/v
+    are written through the table and the returned logits [w, K, vocab]
+    give the target model's next-token distribution at every one of the
+    K positions — a full decode-step logits row for each, computed at
+    prefill-style arithmetic intensity instead of K separate dispatches.
+    ``pos`` is NOT advanced here: the caller advances by the accepted
+    count (rejected positions hold garbage k/v in the row's own private
+    pages above ``pos`` — masked, and overwritten before ``pos`` ever
+    reaches them, the same discipline as pad rows).
+
+    Positions past the slot's table (or the whole row when ``active``
+    is False) scatter into trash block 0, so a wasted verify tail near
+    the end of a generation can never write a shared or foreign page.
+    """
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def c(x):
+        return _cast(x, cd)
+
+    embed, pos_emb = params[0], params[1]
+    w, K = tokens.shape
+    block = cache.k.shape[2]
+    max_pages = table.shape[1]
+    rows = max_pages * block
+    dh = cfg.d_model // cfg.n_heads
+    t0 = cache.pos[:w]  # [w]
+    tw = table[:w]  # [w, max_pages]
+    positions = t0[:, None] + jnp.arange(K)[None, :]  # [w, K], unclipped
+    in_table = positions < rows
+    page = jnp.minimum(positions // block, max_pages - 1)
+    blk = jnp.take_along_axis(tw, page, axis=1)  # [w, K]
+    #: overflow (and frozen-row) scatter targets route to trash — the
+    #: same rule paged_prefill_chunk applies to pad positions
+    valid = in_table
+    if active is not None:
+        valid = valid & active[:, None]
+    blk = jnp.where(valid, blk, 0)
+    off = jnp.where(valid, positions % block, 0)
+    h = c(
+        embed[tokens]
+        + pos_emb[jnp.minimum(positions, cfg.max_len - 1)]
+    )  # [w, K, d]
+    #: query j of slot s sees rows [0, t0_s + j]: its history plus the
+    #: chain tokens scattered this pass (written before the gather)
+    mask = (
+        jnp.arange(rows)[None, None, :] <= positions[:, :, None]
+    )  # [w, K, rows]
+    scale = dh**-0.5
+
+    new_k, new_v = cache.k, cache.v
+    idx = 2
+    for layer in range(cfg.n_layers):
+
+        def attn(x, wq, wk, wv, layer=layer):
+            nonlocal new_k, new_v
+            q = (x @ wq).reshape(w, K, cfg.n_heads, dh)
+            k = (x @ wk).reshape(w, K, cfg.n_heads, dh)
+            v = (x @ wv).reshape(w, K, cfg.n_heads, dh)
+            new_k = new_k.at[layer, blk, off].set(k.astype(new_k.dtype))
+            new_v = new_v.at[layer, blk, off].set(v.astype(new_v.dtype))
+            k_rows = new_k[layer][tw].reshape(w, rows, cfg.n_heads, dh)
+            v_rows = new_v[layer][tw].reshape(w, rows, cfg.n_heads, dh)
+            s = jnp.einsum(
+                "wkhd,wlhd->wkhl", q, k_rows,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(mask[:, :, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum(
+                "wkhl,wlhd->wkhd", p.astype(v_rows.dtype), v_rows,
+                preferred_element_type=jnp.float32,
+            ).reshape(w, K, cfg.d_model)
+
+        h = _block(h, params[idx : idx + PARAMS_PER_LAYER], c, attn)
+        idx += PARAMS_PER_LAYER
+    h = _ln(h, params[idx], params[idx + 1])
+    logits = jnp.dot(
+        c(h), c(embed).T, preferred_element_type=jnp.float32
+    )
+    return logits, PagedKVCache(k=new_k, v=new_v, pos=cache.pos)
+
+
+def truncated_draft(
+    cfg: TransformerConfig,
+    params: Sequence[jax.Array],
+    n_layers: int,
+) -> tuple[TransformerConfig, list[jax.Array]]:
+    """The self-speculative DRAFT: the same checkpoint truncated to its
+    first ``n_layers`` transformer blocks, reusing the full model's
+    embeddings and final layer norm as the draft's output head. No new
+    weights, no training — the draft is expressible in the existing
+    transformer family, so every decode primitive in this module serves
+    it unchanged (its paged cache just has fewer layers). Early layers
+    of a deep residual stack predict the final distribution well enough
+    to propose; the target VERIFIES every proposal, so draft quality
+    only moves the acceptance rate, never correctness."""
+    if not 1 <= n_layers < cfg.n_layers:
+        raise ValueError(
+            f"draft must keep between 1 and {cfg.n_layers - 1} of the "
+            f"model's {cfg.n_layers} layers, got {n_layers}"
+        )
+    draft_cfg = TransformerConfig(
+        vocab=cfg.vocab, d_model=cfg.d_model, n_heads=cfg.n_heads,
+        n_layers=n_layers, d_ff=cfg.d_ff, max_len=cfg.max_len,
+    )
+    draft_params = (
+        list(params[: 2 + PARAMS_PER_LAYER * n_layers])
+        + list(params[-2:])
+    )
+    return draft_cfg, draft_params
 
 
 def generate(

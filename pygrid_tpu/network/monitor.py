@@ -179,7 +179,10 @@ def mark_degraded(ctx) -> None:
     slo = getattr(ctx, "slo", None)
     if slo is None:
         return
-    slo.tick()
+    # evaluate (which ticks internally) rather than bare tick: status
+    # transitions are detected in evaluate, so the network's breach
+    # webhooks fire at monitor cadence even when nobody scrapes
+    slo.evaluate()
     burn = slo.group_burn("heartbeat_rtt", min_events=MIN_EVENTS)
     for node_id, proxy in ctx.proxies.items():
         proxy.degraded = burn.get(node_id, 0.0) > 1.0

@@ -177,7 +177,12 @@ def create_app(
             while True:
                 await asyncio.sleep(interval)
                 try:
-                    app["node"].slo.tick()
+                    # evaluate (not just tick): status transitions are
+                    # detected here, so breach webhooks (§6) fire even
+                    # when nobody is scraping /telemetry/slo — and the
+                    # POST itself runs on the notifier's daemon thread,
+                    # never this loop
+                    app["node"].slo.evaluate()
                 except Exception:  # noqa: BLE001 — cadence must survive
                     logging.getLogger(__name__).exception(
                         "SLO tick failed"

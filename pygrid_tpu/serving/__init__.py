@@ -84,6 +84,11 @@ class ServingManager:
         if hosted.generation_cache is None:
             hosted.generation_cache = decode.from_bundle(hosted.model)
         cfg, params = hosted.generation_cache
+        # live re-partition FIRST: engines over their fair share under
+        # the new denominator give reclaimable blocks back, so the
+        # late registration's grant below can be its true share instead
+        # of min(share, whatever was left) forever (PR-7 follow-up)
+        self.repartition(joining=str(model_id))
         engine = GenerationEngine(
             cfg, params,
             config=self._config_for(str(model_id), cfg),
@@ -122,12 +127,48 @@ class ServingManager:
         dtype = base.cache_dtype or base.compute_dtype
         if dtype is None:
             dtype = pagedkv.default_cache_dtype()
+        extra = 0
+        if pagedkv.spec_enabled(base.spec_decode) and cfg.n_layers >= 2:
+            # the speculative draft's pool rides the same block ids —
+            # its layers are part of what a granted block costs
+            extra = pagedkv.resolve_spec_layers(
+                cfg.n_layers, base.spec_layers
+            )
         blocks = self.budget.blocks_for(
-            model_id, pagedkv.block_bytes(cfg, block, dtype)
+            model_id,
+            pagedkv.block_bytes(cfg, block, dtype, extra_layers=extra),
         )
         if blocks is None:
             return base
         return dataclasses.replace(base, num_blocks=blocks)
+
+    def repartition(self, joining: str | None = None) -> dict[str, int]:
+        """Recompute fair shares after a registry change and ask every
+        over-share engine to give reclaimable blocks back (free +
+        idle-cached only — live requests are untouchable; the engine's
+        :meth:`~pygrid_tpu.serving.engine.GenerationEngine.shrink_blocks`
+        enforces that). Returns blocks shrunk per model. A model UNDER
+        its share cannot grow live (its device arrays are sized) — it
+        picks the larger share up at its next rebuild/re-host, which is
+        why shares are recomputed on every registry change rather than
+        frozen at first registration."""
+        out: dict[str, int] = {}
+        if self.budget.total_bytes is None:
+            return out
+        with self._lock:
+            engines = [
+                (mid, entry[1]) for mid, entry in self._engines.items()
+            ]
+        for mid, engine in engines:
+            per = engine.block_cost_bytes()
+            over = self.budget.overage(mid, joining=joining)
+            if per <= 0 or over < per:
+                continue
+            shrunk = engine.shrink_blocks(over // per)
+            if shrunk:
+                self.budget.record_shrink(mid, shrunk * per)
+                out[mid] = shrunk
+        return out
 
     def evict(self, model_id: str) -> None:
         """Drop (and stop) the engine for a deleted/re-hosted model."""
@@ -136,6 +177,10 @@ class ServingManager:
         self.budget.release(model_id)
         if entry is not None:
             entry[1].close()
+        # shares grew for everyone left; live engines can't expand, but
+        # the recompute keeps the budget ledger honest for the next
+        # registration (and is a no-op when nothing is over-share)
+        self.repartition()
 
     def stats(self) -> list[dict]:
         with self._lock:

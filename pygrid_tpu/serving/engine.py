@@ -31,7 +31,24 @@ Kwon et al. SOSP '23; prefix sharing after RadixAttention):
   keep decoding, so short requests never wait for long ones;
 - at most ``quantum`` decode steps run between admission checks (the
   fairness cap: a queued request's time-to-first-token is bounded by
-  one quantum even when the batch is full of long generations).
+  one quantum even when the batch is full of long generations);
+- when no admission is pending, the whole quantum runs as ONE compiled
+  ``lax.scan`` program (**fused multi-step decode**, default on,
+  ``PYGRID_FUSED_DECODE=off``): per-row token budgets freeze rows that
+  finish mid-scan (their writes trash-route, their positions park), so
+  the host pays one dispatch + one token fetch per quantum instead of
+  per step — the dominant cost of small/medium-model decode;
+- with ``PYGRID_SPEC_DECODE=on``, a **self-speculative** truncated-layer
+  draft of the same checkpoint proposes ``spec_k`` tokens per cycle and
+  the full model verifies them all in one wide block-table step (the
+  draft's proposal scan and the verify run as one program). Greedy
+  output stays bit-identical by construction (the target's argmax
+  arbitrates every emitted token); sampling uses the standard
+  speculative rejection estimator, keyed per (seed, row, position). The
+  draft's k/v pool shares the block tables and ids — allocation, prefix
+  sharing, and COW cover both caches with zero extra bookkeeping — and
+  per-model acceptance-rate telemetry (``serving_spec_*``) tells
+  operators when drafting loses.
 
 ``PYGRID_KV_PAGED=off`` (or ``EngineConfig(paged=False)``) falls back
 to the PR-3 contiguous slot cache — the operational escape hatch and
@@ -111,6 +128,17 @@ class EngineConfig:
     num_blocks: int | None = None
     kv_budget_bytes: int | None = None
     kv_overcommit: float = 4.0
+    #: fused multi-step decode: run ``quantum`` paged decode steps in
+    #: ONE lax.scan program when no admission is pending (default on;
+    #: ``PYGRID_FUSED_DECODE=off``) — kills per-step host dispatch
+    fused: bool | None = None
+    #: self-speculative decoding: a truncated-layer draft of the SAME
+    #: checkpoint proposes ``spec_k`` tokens, the full model verifies
+    #: them in one wide block-table step (OPT-IN: ``PYGRID_SPEC_DECODE``;
+    #: per-model acceptance-rate telemetry says whether it wins)
+    spec_decode: bool | None = None
+    spec_k: int | None = None
+    spec_layers: int | None = None
 
 
 class _Row:
@@ -189,11 +217,36 @@ class GenerationEngine:
         self.model_id = model_id
         self.config = config or EngineConfig()
         self.params = params
+        self._paged = pagedkv.paged_enabled(self.config.paged)
+        #: fused multi-step decode and self-speculative decoding both
+        #: need the block-table discipline (trash-routed frozen writes),
+        #: so they ride the paged path only; spec additionally needs a
+        #: stack deep enough to truncate
+        self._fused = self._paged and pagedkv.fused_enabled(
+            self.config.fused
+        )
+        self._spec = (
+            self._paged
+            and cfg.n_layers >= 2
+            and pagedkv.spec_enabled(self.config.spec_decode)
+        )
+        self._spec_k = pagedkv.resolve_spec_k(self.config.spec_k)
+        draft_cfg = None
+        self._draft_params = None
+        if self._spec:
+            n_draft = pagedkv.resolve_spec_layers(
+                cfg.n_layers, self.config.spec_layers
+            )
+            draft_cfg, self._draft_params = decode.truncated_draft(
+                cfg, params, n_draft
+            )
+        self._draft_cfg = draft_cfg
         self.programs = ProgramSet(
             cfg,
             compute_dtype=self.config.compute_dtype,
             cache_dtype=self.config.cache_dtype,
             model_id=model_id,
+            draft_cfg=draft_cfg,
         )
         self._prompt_buckets = prompt_buckets(
             cfg.max_len, self.config.min_prompt_bucket
@@ -212,7 +265,6 @@ class GenerationEngine:
                 else pagedkv.default_cache_dtype()
             )
         )
-        self._paged = pagedkv.paged_enabled(self.config.paged)
         if self._paged:
             self._block = pagedkv.resolve_block_size(
                 cfg.max_len, self.config.block_size
@@ -222,7 +274,12 @@ class GenerationEngine:
                 num_blocks = int(self.config.num_blocks)
             elif self.config.kv_budget_bytes is not None:
                 per_block = pagedkv.block_bytes(
-                    cfg, self._block, self._kv_dtype
+                    cfg, self._block, self._kv_dtype,
+                    # the draft pool shares block ids: a block's true
+                    # byte cost under spec decode includes its layers
+                    extra_layers=(
+                        self._draft_cfg.n_layers if self._spec else 0
+                    ),
                 )
                 # the trash block counts INSIDE the byte budget (same
                 # accounting as DeviceBudget.blocks_for): an operator
@@ -235,6 +292,10 @@ class GenerationEngine:
             self._num_blocks = max(2, num_blocks)
             self._pool = pagedkv.BlockPool(self._num_blocks)
             self._prefix = pagedkv.PrefixCache(self._pool, self._block)
+            #: blocks given back to the device budget by live
+            #: re-partitioning (shrink_blocks) — survives _fail_all's
+            #: pool rebuild
+            self._shrunk_blocks = 0
             #: host mirror of the device block table; rebuilt lazily
             #: (``_table``) after any admission/free edit
             self._table_np = np.zeros(
@@ -257,6 +318,22 @@ class GenerationEngine:
         # held as separate refs: the jitted programs donate and return
         # them, and the engine swaps in the new buffers every call
         self._k, self._v, self._pos = cache.k, cache.v, cache.pos
+        #: the draft's k/v pool: same block ids/tables as the target
+        #: (allocation covers both), fewer layers; position state is
+        #: shared — the draft is always exactly at the target's pos
+        self._dk = self._dv = None
+        if self._spec:
+            dcache = decode.init_paged_cache(
+                self._draft_cfg, self.config.max_slots,
+                self._num_blocks, self._block, dtype=self._kv_dtype,
+            )
+            self._dk, self._dv = dcache.k, dcache.v
+        self._fused_scans = 0
+        self._fused_steps = 0
+        self._fused_wasted = 0
+        self._spec_verifies = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._slots: list[_Row | None] = [None] * self.config.max_slots
         self._queue: deque[_Row] = deque()
         self._lock = threading.Lock()
@@ -421,7 +498,35 @@ class GenerationEngine:
                 "slots": slots,
                 "queued_requests": queued,
                 "paged": self._paged,
+                "fused": self._fused,
+                "spec": self._spec,
             }
+            if self._fused:
+                out.update(
+                    {
+                        "fused_scans": self._fused_scans,
+                        "fused_steps": self._fused_steps,
+                        "fused_wasted_steps": self._fused_wasted,
+                    }
+                )
+            if self._spec:
+                out.update(
+                    {
+                        "spec_k": self._spec_k,
+                        "spec_draft_layers": self._draft_cfg.n_layers,
+                        "spec_verifies": self._spec_verifies,
+                        "spec_proposed": self._spec_proposed,
+                        "spec_accepted": self._spec_accepted,
+                        # the honest per-model verdict: below ~1/k the
+                        # draft is pure overhead and the operator
+                        # should turn spec decode off for this model
+                        "spec_acceptance": round(
+                            self._spec_accepted / self._spec_proposed, 4
+                        )
+                        if self._spec_proposed
+                        else None,
+                    }
+                )
             if self._paged:
                 live_rows = [r for r in self._slots if r is not None]
                 alloc_pages = sum(
@@ -434,6 +539,7 @@ class GenerationEngine:
                     {
                         "block_size": self._block,
                         "kv_blocks_total": self._pool.usable,
+                        "kv_blocks_retired": self._pool.retired_count(),
                         "kv_blocks_free": self._pool.free_count(),
                         "kv_blocks_cached": self._prefix.block_count(),
                         # cache-ONLY (reclaimable) blocks; a cached
@@ -462,6 +568,39 @@ class GenerationEngine:
     def compile_count(self) -> int:
         return self.programs.compile_count()
 
+    def block_cost_bytes(self) -> int:
+        """Device bytes one of this engine's KV blocks really costs —
+        target layers plus the speculative draft's layers when spec
+        decode is on (the draft shares block ids, so a block carries
+        rows in BOTH pools). 0 on the contiguous path."""
+        if not self._paged:
+            return 0
+        extra = self._draft_cfg.n_layers if self._spec else 0
+        return pagedkv.block_bytes(
+            self.cfg, self._block, self._kv_dtype, extra_layers=extra
+        )
+
+    def shrink_blocks(self, n: int) -> int:
+        """Give up to ``n`` KV blocks back to the node's device budget
+        — live re-partitioning when another model registers against the
+        same ``PYGRID_KV_BUDGET``. Only RECLAIMABLE blocks move: free
+        blocks first, then idle-cached prefix entries are evicted to
+        free more; a block held by a live request (or a prefix chain a
+        live request still reads) is untouchable, so in-flight
+        generations never fail. Returns the count actually retired.
+        The device arrays stay allocated until the next cache
+        reallocation (re-host or failure recovery) — the give-back is
+        ADMISSION capacity first, bytes at the next rebuild
+        (docs/SERVING.md §Live re-partitioning)."""
+        if not self._paged or n <= 0:
+            return 0
+        retired = self._pool.retire(n)
+        while retired < n and self._prefix.evict_one():
+            retired += self._pool.retire(n - retired)
+        with self._lock:
+            self._shrunk_blocks += retired
+        return retired
+
     def warmup(self, prompt_lens: tuple[int, ...] = ()) -> None:
         """Compile AND execute the decode width buckets (and the prompt
         buckets the given lengths land in) ahead of traffic, so the
@@ -483,9 +622,18 @@ class GenerationEngine:
             if bucket in seen:
                 continue
             seen.add(bucket)
-            if self._paged:
+            if self._spec:
                 # all-zero table: every warmup write lands in the
                 # trash block, so no future request can observe it
+                fn = self.programs.spec_prefill(bucket)
+                _tok, self._k, self._v, self._pos, self._dk, self._dv = fn(
+                    self.params, self._draft_params,
+                    self._k, self._v, self._pos, self._dk, self._dv,
+                    self._table(), jnp.int32(0),
+                    jnp.zeros((bucket,), jnp.int32), jnp.int32(0),
+                    jnp.int32(1), jnp.float32(0.0), zero_key,
+                )
+            elif self._paged:
                 fn = self.programs.paged_prefill(bucket)
                 _tok, self._k, self._v, self._pos = fn(
                     self.params, self._k, self._v, self._pos,
@@ -501,7 +649,19 @@ class GenerationEngine:
                     jnp.int32(1), jnp.float32(0.0), zero_key,
                 )
         for w in self._widths:
-            if self._paged:
+            if self._spec:
+                # a spec engine decodes ONLY through the verify program
+                # (all-frozen warmup: counts 0, writes trash-routed)
+                fn = self.programs.spec_verify(w, self._spec_k)
+                _e, _a, _c, self._k, self._v, self._pos, self._dk, self._dv = fn(
+                    self.params, self._draft_params, self._k, self._v,
+                    self._pos, self._dk, self._dv, self._table(),
+                    jnp.zeros((w,), jnp.int32),
+                    jnp.zeros((w,), jnp.bool_),
+                    jnp.zeros((w,), jnp.float32),
+                    jnp.zeros((w, self._spec_k, 2), jnp.uint32),
+                )
+            elif self._paged:
                 fn = self.programs.paged_decode(w)
                 _toks, self._k, self._v, self._pos = fn(
                     self.params, self._k, self._v, self._pos,
@@ -509,6 +669,20 @@ class GenerationEngine:
                     jnp.zeros((w,), jnp.float32),
                     jnp.zeros((w, 2), jnp.uint32),
                 )
+                if self._fused:
+                    # zero budgets: every row frozen, nothing advances
+                    fn = self.programs.paged_decode_fused(
+                        w, self.config.quantum
+                    )
+                    _e, self._k, self._v, self._pos = fn(
+                        self.params, self._k, self._v, self._pos,
+                        self._table(), jnp.zeros((w,), jnp.int32),
+                        jnp.zeros((w,), jnp.int32),
+                        jnp.zeros((w,), jnp.float32),
+                        jnp.zeros(
+                            (self.config.quantum, w, 2), jnp.uint32
+                        ),
+                    )
             else:
                 fn = self.programs.decode(w)
                 _toks, self._k, self._v, self._pos = fn(
@@ -560,12 +734,30 @@ class GenerationEngine:
                     return
             try:
                 self._admit()
-                steps = 0
-                while steps < self.config.quantum and self._live:
-                    freed = self._step()
-                    steps += 1
-                    if freed and self._queue:
-                        break  # a slot opened and someone's waiting
+                if self._spec and self._live:
+                    # speculative mode: each verify cycle advances every
+                    # live row by up to spec_k tokens in one dispatch;
+                    # the quantum still caps tokens between admission
+                    # checks (fairness is measured in emitted tokens)
+                    emitted = 0
+                    while emitted < self.config.quantum and self._live:
+                        done, freed = self._spec_cycle()
+                        emitted += max(1, done)
+                        if freed and self._queue:
+                            break
+                elif self._fused and self._live and not self._queue:
+                    # no admission pending: burn the whole quantum in
+                    # ONE compiled scan — rows finishing mid-scan
+                    # freeze (wasted steps accepted; zero dispatches
+                    # saved per step is the whole point)
+                    self._fused_scan()
+                else:
+                    steps = 0
+                    while steps < self.config.quantum and self._live:
+                        freed = self._step()
+                        steps += 1
+                        if freed and self._queue:
+                            break  # a slot opened and someone's waiting
             except Exception as err:  # noqa: BLE001 — device-loop boundary
                 logger.exception("serving engine step failed")
                 self._fail_all(
@@ -614,17 +806,34 @@ class GenerationEngine:
                 bucket = self._prompt_bucket(chunk_len)
                 padded = np.zeros(bucket, np.int32)
                 padded[:chunk_len] = row.prompt[row.start :]
-                fn = self.programs.paged_prefill(bucket)
-                # the cache buffers are single-writer: only the engine
-                # thread swaps _k/_v/_pos between lock epochs
-                # gridlint: disable-next=GL202
-                tok, self._k, self._v, self._pos = fn(
-                    self.params, self._k, self._v, self._pos,
-                    self._table(), jnp.int32(slot), jnp.asarray(padded),
-                    jnp.int32(row.start), jnp.int32(len(row.prompt)),
-                    jnp.float32(row.temperature),
-                    self._key_for(row, 0),
-                )
+                if self._spec:
+                    # spec admission prefills the DRAFT cache too (it
+                    # needs the prompt's k/v before it can propose) —
+                    # one program, first token still from the target
+                    fn = self.programs.spec_prefill(bucket)
+                    # gridlint: disable-next=GL202 — cache buffers are engine-thread-confined
+                    tok, self._k, self._v, self._pos, self._dk, self._dv = fn(
+                        self.params, self._draft_params,
+                        self._k, self._v, self._pos, self._dk, self._dv,
+                        self._table(), jnp.int32(slot),
+                        jnp.asarray(padded), jnp.int32(row.start),
+                        jnp.int32(len(row.prompt)),
+                        jnp.float32(row.temperature),
+                        self._key_for(row, 0),
+                    )
+                else:
+                    fn = self.programs.paged_prefill(bucket)
+                    # the cache buffers are single-writer: only the
+                    # engine thread swaps _k/_v/_pos between lock epochs
+                    # gridlint: disable-next=GL202
+                    tok, self._k, self._v, self._pos = fn(
+                        self.params, self._k, self._v, self._pos,
+                        self._table(), jnp.int32(slot),
+                        jnp.asarray(padded),
+                        jnp.int32(row.start), jnp.int32(len(row.prompt)),
+                        jnp.float32(row.temperature),
+                        self._key_for(row, 0),
+                    )
                 # publish the full-prompt pages for future prefix hits
                 # (first prefill wins; a matched chain is only touched)
                 # gridlint: disable-next=GL202 — PrefixCache takes its own lock; only the engine thread mutates it
@@ -710,21 +919,28 @@ class GenerationEngine:
             self._table_dirty = False
         return self._table_dev
 
+    def _live_snapshot(self) -> tuple[list[tuple[int, "_Row"]], int]:
+        """(live (slot, row) pairs, covering width bucket) for one
+        dispatch — shared by the per-step, fused-scan, and speculative
+        paths. Snapshot under the lock and never re-index self._slots
+        after releasing it (a close() that outwaited its join could
+        swap the list under us). Width 0 means nothing is live."""
+        with self._lock:
+            live = [
+                (i, r) for i, r in enumerate(self._slots) if r is not None
+            ]
+        if not live:
+            return [], 0
+        return live, next(w for w in self._widths if w > live[-1][0])
+
     def _step(self) -> bool:
         """One batched decode step over every live slot; returns True if
         any slot freed (a finished request left the batch)."""
         import jax.numpy as jnp
 
-        with self._lock:
-            # snapshot (index, row) pairs — never re-index self._slots
-            # after releasing the lock (a close() that outwaited its
-            # join could swap the list under us)
-            live = [
-                (i, r) for i, r in enumerate(self._slots) if r is not None
-            ]
+        live, width = self._live_snapshot()
         if not live:
             return False
-        width = next(w for w in self._widths if w > live[-1][0])
         tokens = np.zeros(width, np.int32)
         temps = np.zeros(width, np.float32)
         keys = np.zeros((width, 2), np.uint32)
@@ -760,6 +976,150 @@ class GenerationEngine:
             if self._emit(i, row, int(toks[i])):
                 freed = True
         return freed
+
+    def _fused_scan(self) -> None:
+        """Up to ``quantum`` decode steps for every live slot in ONE
+        compiled program (``programs.paged_decode_fused``): per-row
+        token budgets freeze finished rows inside the scan (their
+        writes trash-route, their position parks), the emitted
+        [steps, w] matrix drains into pendings afterwards. Host cost
+        per quantum: one dispatch + one device→host token fetch,
+        instead of ``quantum`` of each. Engine thread only."""
+        import jax.numpy as jnp
+
+        live, width = self._live_snapshot()
+        if not live:
+            return
+        steps = self.config.quantum
+        tokens = np.zeros(width, np.int32)
+        temps = np.zeros(width, np.float32)
+        budget = np.zeros(width, np.int32)
+        keys = np.zeros((steps, width, 2), np.uint32)
+        for i, row in live:
+            tokens[i] = row.last_token
+            temps[i] = row.temperature
+            need = row.n_new - len(row.out)
+            budget[i] = need
+            if row.keys is not None:
+                done = len(row.out)
+                take = min(steps, need)
+                keys[:take, i] = row.keys[done : done + take]
+        t0 = time.perf_counter()
+        fn = self.programs.paged_decode_fused(width, steps)
+        # gridlint: disable-next=GL202 — cache buffers are engine-thread-confined
+        toks, self._k, self._v, self._pos = fn(
+            self.params, self._k, self._v, self._pos, self._table(),
+            jnp.asarray(tokens), jnp.asarray(budget), jnp.asarray(temps),
+            jnp.asarray(keys),
+        )
+        toks = np.asarray(toks)  # [steps, width]
+        dt = time.perf_counter() - t0
+        telemetry.observe(
+            "serving_batch_occupancy", float(len(live)),
+            bounds=_OCCUPANCY_BOUNDS,
+        )
+        drained = 0
+        for i, row in live:
+            need = min(steps, row.n_new - len(row.out))
+            drained += need
+            for j in range(need):
+                telemetry.observe("serving_token_seconds", dt / steps)
+                self._emit(i, row, int(toks[j, i]))
+        wasted = steps * len(live) - drained
+        with self._lock:
+            self._fused_scans += 1
+            self._fused_steps += steps
+            self._fused_wasted += wasted
+        telemetry.incr("serving_fused_scans_total", model=self.model_id)
+        telemetry.incr(
+            "serving_fused_steps_total", steps, model=self.model_id
+        )
+        if wasted:
+            telemetry.incr(
+                "serving_fused_wasted_steps_total", wasted,
+                model=self.model_id,
+            )
+
+    def _spec_cycle(self) -> tuple[int, bool]:
+        """One speculative cycle: the truncated-layer draft proposes
+        ``spec_k`` tokens per live row and the full model verifies them
+        all in one wide block-table step (``programs.spec_verify`` — a
+        single compiled program including the draft's proposal scan).
+        Returns (most tokens any row emitted, any slot freed). Engine
+        thread only."""
+        import jax.numpy as jnp
+
+        live, width = self._live_snapshot()
+        if not live:
+            return 0, False
+        K = self._spec_k
+        tokens = np.zeros(width, np.int32)
+        temps = np.zeros(width, np.float32)
+        active = np.zeros(width, bool)
+        keys = np.zeros((width, K, 2), np.uint32)
+        for i, row in live:
+            tokens[i] = row.last_token
+            temps[i] = row.temperature
+            active[i] = True
+            if row.keys is not None:
+                done = len(row.out)
+                # per-position key schedule, clamped at the tail: a
+                # verify window reaching past n_new reuses the last
+                # key for tokens the drain below discards anyway
+                idx = np.minimum(
+                    np.arange(done, done + K), row.n_new - 1
+                )
+                keys[i] = row.keys[idx]
+        t0 = time.perf_counter()
+        fn = self.programs.spec_verify(width, K)
+        # gridlint: disable-next=GL202 — cache buffers are engine-thread-confined
+        emitted, accepted, counts, self._k, self._v, self._pos, self._dk, self._dv = fn(
+            self.params, self._draft_params, self._k, self._v,
+            self._pos, self._dk, self._dv, self._table(),
+            jnp.asarray(tokens), jnp.asarray(active),
+            jnp.asarray(temps), jnp.asarray(keys),
+        )
+        emitted = np.asarray(emitted)
+        accepted = np.asarray(accepted)
+        counts = np.asarray(counts)
+        dt = time.perf_counter() - t0
+        telemetry.observe(
+            "serving_batch_occupancy", float(len(live)),
+            bounds=_OCCUPANCY_BOUNDS,
+        )
+        freed = False
+        max_emit = 0
+        proposed_total = 0
+        accepted_total = 0
+        for i, row in live:
+            m = min(int(counts[i]), row.n_new - len(row.out))
+            max_emit = max(max_emit, m)
+            proposed_total += K
+            # acceptance the row could USE: proposals verified past the
+            # row's n_new are wasted verify width, not wins — the
+            # acceptance-rate gauge must not flatter the draft
+            accepted_total += min(int(accepted[i]), m)
+            for j in range(m):
+                telemetry.observe(
+                    "serving_token_seconds", dt / max(1, int(counts[i]))
+                )
+                if self._emit(i, row, int(emitted[i, j])):
+                    freed = True
+        with self._lock:
+            self._spec_verifies += 1
+            self._spec_proposed += proposed_total
+            self._spec_accepted += accepted_total
+        telemetry.incr("serving_spec_verifies_total", model=self.model_id)
+        telemetry.incr(
+            "serving_spec_proposed_total", proposed_total,
+            model=self.model_id,
+        )
+        if accepted_total:
+            telemetry.incr(
+                "serving_spec_accepted_total", accepted_total,
+                model=self.model_id,
+            )
+        return max_emit, freed
 
     def _emit(self, slot: int, row: _Row, token: int) -> bool:
         """Append one generated token to a row; retire the row (freeing
@@ -803,6 +1163,7 @@ class GenerationEngine:
 
     def _fail_all(self, err: Exception, reset_cache: bool = True) -> None:
         cache = None
+        dcache = None
         snapshot = None
         if reset_cache:
             from pygrid_tpu.models import decode
@@ -816,10 +1177,25 @@ class GenerationEngine:
             # the next request instead of failing forever on deleted
             # arrays (skipped on close: no one decodes again)
             if self._paged:
+                # a live re-partition (shrink_blocks) is REALIZED in
+                # bytes here: the fresh arrays are sized to the
+                # shrunken pool, so the budget give-back stops being
+                # merely logical at the first cache reallocation
+                with self._lock:
+                    self._num_blocks = max(
+                        2, self._num_blocks - self._shrunk_blocks
+                    )
+                    self._shrunk_blocks = 0
                 cache = decode.init_paged_cache(
                     self.cfg, self.config.max_slots, self._num_blocks,
                     self._block, dtype=self._kv_dtype,
                 )
+                if self._spec:
+                    dcache = decode.init_paged_cache(
+                        self._draft_cfg, self.config.max_slots,
+                        self._num_blocks, self._block,
+                        dtype=self._kv_dtype,
+                    )
             else:
                 cache = decode.init_slot_cache(
                     self.cfg, self.config.max_slots, dtype=self._kv_dtype
@@ -834,12 +1210,17 @@ class GenerationEngine:
                 self._demand_pages = 0
             if cache is not None:
                 self._k, self._v, self._pos = cache.k, cache.v, cache.pos
+            if dcache is not None:
+                self._dk, self._dv = dcache.k, dcache.v
         if self._paged:
             if reset_cache:
                 # the device pool was reallocated: every cached prefix
                 # block now names stale (zeroed) data — rebuild the
                 # allocator and drop the prefix cache wholesale (engine
                 # thread only; every request future already failed above)
+                # _num_blocks was already rebased above (shrunk blocks
+                # realized in the fresh arrays), so the new pool simply
+                # matches the new device allocation
                 # gridlint: disable-next=GL202 — engine-thread-confined swap, requests already failed
                 self._pool = pagedkv.BlockPool(self._num_blocks)
                 # gridlint: disable-next=GL202 — engine-thread-confined swap, requests already failed

@@ -66,6 +66,57 @@ def paged_enabled(requested: bool | None = None) -> bool:
     return os.environ.get("PYGRID_KV_PAGED", "").lower() not in ("off", "0")
 
 
+def fused_enabled(requested: bool | None = None) -> bool:
+    """Fused multi-step decode (one ``lax.scan`` program per quantum of
+    decode steps) is the default on the paged path;
+    ``PYGRID_FUSED_DECODE=off|0`` (or ``EngineConfig(fused=False)``)
+    reverts to one dispatch per step — the PR-3/7 behavior and the
+    bench baseline for the dispatch-overhead comparison."""
+    if requested is not None:
+        return bool(requested)
+    return os.environ.get(
+        "PYGRID_FUSED_DECODE", ""
+    ).lower() not in ("off", "0")
+
+
+def spec_enabled(requested: bool | None = None) -> bool:
+    """Self-speculative decoding is OPT-IN per deployment
+    (``PYGRID_SPEC_DECODE=on|1`` or ``EngineConfig(spec_decode=True)``):
+    whether a truncated-layer draft wins depends on the checkpoint (the
+    acceptance-rate telemetry is how operators find out), so it never
+    silently becomes the default."""
+    if requested is not None:
+        return bool(requested)
+    return os.environ.get(
+        "PYGRID_SPEC_DECODE", ""
+    ).lower() in ("on", "1", "true")
+
+
+def resolve_spec_k(requested: int | None = None) -> int:
+    """Draft proposals per verify step (``PYGRID_SPEC_K``, default 4),
+    clamped to [1, 16] — the verify pass widens linearly with k, and a
+    typo must not compile a 1000-wide program."""
+    if requested is None:
+        try:
+            requested = int(os.environ.get("PYGRID_SPEC_K", ""))
+        except (TypeError, ValueError):
+            requested = 4
+    return max(1, min(int(requested), 16))
+
+
+def resolve_spec_layers(n_layers: int, requested: int | None = None) -> int:
+    """Draft depth (``PYGRID_SPEC_LAYERS``, default: half the stack,
+    floor 1), clamped to [1, n_layers - 1] so the draft is always a
+    strict truncation — a draft as deep as the target proposes at full
+    cost and can never win."""
+    if requested is None:
+        try:
+            requested = int(os.environ.get("PYGRID_SPEC_LAYERS", ""))
+        except (TypeError, ValueError):
+            requested = n_layers // 2
+    return max(1, min(int(requested), max(1, n_layers - 1)))
+
+
 def default_cache_dtype() -> Any:
     """The KV cache dtype when neither ``cache_dtype`` nor
     ``compute_dtype`` is set: **bf16 on TPU** (decode is bandwidth-bound
@@ -117,14 +168,17 @@ def parse_weights(raw: str | None) -> dict[str, float]:
     return out
 
 
-def block_bytes(cfg, block: int, dtype: Any) -> int:
+def block_bytes(cfg, block: int, dtype: Any, extra_layers: int = 0) -> int:
     """Device bytes one KV block costs for ``cfg``: k AND v, all layers
-    — the unit the budget partitions."""
+    — the unit the budget partitions. ``extra_layers`` adds the
+    speculative DRAFT's layers: the draft shares the pool's block ids
+    (same tables, its own k/v arrays), so a block's true device cost
+    when spec decode is on is target layers + draft layers."""
     import jax.numpy as jnp
 
     dh = cfg.d_model // cfg.n_heads
     return int(
-        2 * cfg.n_layers * block * cfg.n_heads * dh
+        2 * (cfg.n_layers + extra_layers) * block * cfg.n_heads * dh
         * jnp.dtype(dtype).itemsize
     )
 
@@ -145,10 +199,15 @@ class BlockPool:
         #: LIFO free list — reuse the hottest block first
         self._free: list[int] = list(range(self.num_blocks - 1, 0, -1))
         self._ref = np.zeros(self.num_blocks, np.int64)
+        #: blocks withdrawn from circulation by live re-partitioning
+        #: (DeviceBudget.repartition): never allocated again, excluded
+        #: from ``usable`` — the logical give-back another model's
+        #: engine is sized against
+        self._retired = 0
 
     @property
     def usable(self) -> int:
-        return self.num_blocks - 1
+        return self.num_blocks - 1 - self._retired
 
     def free_count(self) -> int:
         with self._lock:
@@ -185,6 +244,29 @@ class BlockPool:
                 self._ref[b] -= 1
                 if self._ref[b] == 0:
                     self._free.append(b)
+
+    def retire(self, n: int) -> int:
+        """Withdraw up to ``n`` FREE blocks from circulation forever
+        (live re-partitioning: a late-registered model's share comes out
+        of the blocks this engine is not using). Returns how many were
+        actually retired — never more than the free list holds, so a
+        block some request or the prefix cache still references is
+        untouchable by construction. Retired blocks keep a poisoned
+        refcount: a release/incref naming one raises like any other
+        refcount bug."""
+        if n <= 0:
+            return 0
+        with self._lock:
+            take = min(int(n), len(self._free))
+            for _ in range(take):
+                b = self._free.pop()
+                self._ref[b] = -1
+            self._retired += take
+            return take
+
+    def retired_count(self) -> int:
+        with self._lock:
+            return self._retired
 
     def held(self) -> int:
         """Blocks currently referenced by anyone (excludes trash)."""
@@ -394,6 +476,19 @@ class DeviceBudget:
     def weight_of(self, model_id: str) -> float:
         return float(self.weights.get(model_id, 1.0))
 
+    def _fair_share_locked(
+        self, model_id: str, joining: str | None = None
+    ) -> int:
+        """``model_id``'s exact byte share with every currently
+        registered model (plus declared-but-unregistered weights, plus
+        a prospective ``joining`` model) in the denominator. Caller
+        holds the lock."""
+        members = set(self._allocated) | set(self.weights) | {model_id}
+        if joining:
+            members.add(joining)
+        denom = sum(self.weight_of(m) for m in members)
+        return int(self.total_bytes * self.weight_of(model_id) / denom)
+
     def blocks_for(self, model_id: str, bytes_per_block: int) -> int | None:
         """The block count ``model_id``'s engine should allocate, or
         None when no budget is configured (engine falls back to
@@ -404,18 +499,40 @@ class DeviceBudget:
         with self._lock:
             live = dict(self._allocated)
             live.pop(model_id, None)
-            denom = sum(
-                self.weight_of(m) for m in live
-            ) + sum(
-                w for m, w in self.weights.items()
-                if m not in live and m != model_id
-            ) + self.weight_of(model_id)
-            share = int(self.total_bytes * self.weight_of(model_id) / denom)
+            self._allocated.pop(model_id, None)
+            share = self._fair_share_locked(model_id)
             remaining = self.total_bytes - sum(live.values())
             grant = max(min(share, remaining), 2 * bytes_per_block)
             blocks = max(2, grant // bytes_per_block)
             self._allocated[model_id] = blocks * bytes_per_block
             return int(blocks)
+
+    def overage(self, model_id: str, joining: str | None = None) -> int:
+        """Bytes ``model_id`` currently holds BEYOND its fair share
+        under the present registry (with ``joining`` — a model about to
+        register — counted into the denominator) — what live
+        re-partitioning asks its engine to give back (shrinking only
+        reclaimable blocks; see :meth:`record_shrink`). 0 when no
+        budget is configured or the model is at/under its share."""
+        if self.total_bytes is None:
+            return 0
+        with self._lock:
+            held = self._allocated.get(model_id)
+            if held is None:
+                return 0
+            return max(
+                0, held - self._fair_share_locked(model_id, joining)
+            )
+
+    def record_shrink(self, model_id: str, bytes_freed: int) -> None:
+        """Book a live engine's give-back: the freed bytes return to
+        ``remaining`` so the next registration's grant can use them."""
+        if bytes_freed <= 0:
+            return
+        with self._lock:
+            held = self._allocated.get(model_id)
+            if held is not None:
+                self._allocated[model_id] = max(0, held - int(bytes_freed))
 
     def release(self, model_id: str) -> None:
         with self._lock:

@@ -60,15 +60,22 @@ class ProgramSet:
         compute_dtype: Any | None = None,
         cache_dtype: Any | None = None,
         model_id: str = "",
+        draft_cfg: Any | None = None,
     ) -> None:
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.cache_dtype = cache_dtype
         self.model_id = model_id
+        #: truncated-layer draft config for the speculative programs
+        #: (None: spec_prefill/spec_verify are unavailable)
+        self.draft_cfg = draft_cfg
         self._prefill: dict[int, Callable] = {}
         self._decode: dict[int, Callable] = {}
         self._paged_prefill: dict[int, Callable] = {}
         self._paged_decode: dict[int, Callable] = {}
+        self._paged_fused: dict[tuple[int, int], Callable] = {}
+        self._spec_prefill: dict[int, Callable] = {}
+        self._spec_verify: dict[tuple[int, int], Callable] = {}
         self._compiles = 0
 
     def compile_count(self) -> int:
@@ -86,6 +93,9 @@ class ProgramSet:
             *self._decode.values(),
             *self._paged_prefill.values(),
             *self._paged_decode.values(),
+            *self._paged_fused.values(),
+            *self._spec_prefill.values(),
+            *self._spec_verify.values(),
         ]:
             size = getattr(fn, "_cache_size", None)
             total += size() if callable(size) else 1
@@ -208,6 +218,64 @@ class ProgramSet:
             self._count("paged_prefill")
         return fn
 
+    def paged_decode_fused(self, width: int, steps: int) -> Callable:
+        """``fn(params, k, v, pos, table, tokens[w], budget[w],
+        temps[w], keys[steps, w, 2]) -> (emitted[steps, w], k, v, pos)``
+        — up to ``steps`` block-table decode steps in ONE compiled
+        program (``lax.scan``), killing the per-step host→device
+        dispatch that dominates small-model decode.
+
+        ``budget[i]`` is how many tokens row ``i`` still needs: the scan
+        decrements it per step and FREEZES the row at zero (k/v write to
+        trash, position parked, token carried — see
+        ``decode.paged_decode_step``'s ``active`` mask), so rows that
+        finish mid-scan cost wasted FLOPs but zero state damage. The
+        emitted [steps, w] matrix holds every step's token; the engine
+        drains the first ``budget`` entries per row and ignores the
+        frozen tail. ``steps`` is static (the engine's quantum — the
+        fairness cap between admission checks), so the compiled surface
+        stays one program per (width, quantum)."""
+        cache_key = (width, steps)
+        fn = self._paged_fused.get(cache_key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            from pygrid_tpu.models import decode
+
+            cfg, cd = self.cfg, self.compute_dtype
+
+            def _fused(params, k, v, pos, table, tokens, budget, temps, keys):
+                def body(carry, step_keys):
+                    kk, vv, pp, tok, remaining = carry
+                    cache = decode.PagedKVCache(k=kk, v=vv, pos=pp)
+                    alive = remaining > 0
+                    logits, cache = decode.paged_decode_step(
+                        params, cache, table, tok, cfg, cd, active=alive
+                    )
+                    picked = jax.vmap(self._pick)(logits, temps, step_keys)
+                    nxt = jnp.where(alive, picked, tok)
+                    carry = (
+                        cache.k, cache.v, cache.pos, nxt,
+                        remaining - alive.astype(jnp.int32),
+                    )
+                    return carry, nxt
+
+                (kk, vv, pp, _, _), emitted = lax.scan(
+                    body, (k, v, pos, tokens, budget), keys
+                )
+                return emitted, kk, vv, pp
+
+            fn = telemetry.profiler.wrap(
+                jax.jit(_fused, donate_argnums=(1, 2, 3)),
+                kind="paged_decode_fused", bucket=width,
+                model_id=self.model_id,
+            )
+            self._paged_fused[cache_key] = fn
+            self._count("paged_decode_fused")
+        return fn
+
     def paged_decode(self, width: int) -> Callable:
         """``fn(params, k, v, pos, table, tokens[w], temps[w],
         keys[w, 2]) -> (next_tokens[w], k, v, pos)`` — one block-table
@@ -235,4 +303,185 @@ class ProgramSet:
             )
             self._paged_decode[width] = fn
             self._count("paged_decode")
+        return fn
+
+    # ── self-speculative programs (truncated-layer draft) ───────────────
+    #
+    # The draft shares the paged pool's BLOCK IDS: its k/v arrays carry
+    # fewer layers but use the same tables, so every allocation /
+    # prefix-share / COW rule covers both caches with zero extra
+    # bookkeeping. Both programs donate every cache buffer and keep the
+    # table/start/length traced — same no-recompile contract as the
+    # non-speculative set.
+
+    def spec_prefill(self, bucket: int) -> Callable:
+        """``fn(params, dparams, k, v, pos, dk, dv, table, slot,
+        chunk[bucket], start, length, temp, key) -> (first_token, k, v,
+        pos, dk, dv)`` — admission when spec decode is on: one program
+        prefills the chunk through BOTH caches (the draft needs the
+        prompt's k/v before it can propose), first token picked from the
+        TARGET logits, so admission output is bit-identical to the
+        non-speculative path."""
+        fn = self._spec_prefill.get(bucket)
+        if fn is None:
+            import jax
+
+            from pygrid_tpu.models import decode
+
+            cfg, dcfg, cd = self.cfg, self.draft_cfg, self.compute_dtype
+
+            def _spec_prefill(
+                params, dparams, k, v, pos, dk, dv, table, slot, chunk,
+                start, length, temp, key,
+            ):
+                cache = decode.PagedKVCache(k=k, v=v, pos=pos)
+                logits, cache = decode.paged_prefill_chunk(
+                    params, cache, table, slot, chunk, start, length,
+                    cfg, cd,
+                )
+                dcache = decode.PagedKVCache(k=dk, v=dv, pos=pos)
+                # draft logits are dead code (XLA DCEs the draft's
+                # output head) — this pass exists only to write the
+                # draft's k/v rows for the prompt
+                _dl, dcache = decode.paged_prefill_chunk(
+                    dparams, dcache, table, slot, chunk, start, length,
+                    dcfg, cd,
+                )
+                tok = self._pick(logits, temp, key)
+                return tok, cache.k, cache.v, cache.pos, dcache.k, dcache.v
+
+            fn = telemetry.profiler.wrap(
+                jax.jit(_spec_prefill, donate_argnums=(2, 3, 4, 5, 6)),
+                kind="spec_prefill", bucket=bucket,
+                model_id=self.model_id,
+            )
+            self._spec_prefill[bucket] = fn
+            self._count("spec_prefill")
+        return fn
+
+    def spec_verify(self, width: int, k_spec: int) -> Callable:
+        """``fn(params, dparams, k, v, pos, dk, dv, table, tokens[w],
+        active[w], temps[w], keys[w, K, 2]) -> (emitted[w, K],
+        accepted[w], counts[w], k, v, pos, dk, dv)`` — one speculative
+        decode cycle for the first ``w`` slots in ONE compiled program:
+
+        1. the DRAFT proposes K tokens autoregressively (a ``lax.scan``
+           of truncated-layer block-table steps — cheap, and fused so
+           the chain costs one dispatch, not K);
+        2. the TARGET verifies all K in one wide step through the block
+           tables (``decode.paged_verify_chunk`` — prefill-style
+           arithmetic intensity);
+        3. acceptance picks the emitted run: greedy rows accept while
+           the proposal equals the target argmax and emit the target's
+           token at the first mismatch — BIT-IDENTICAL to plain greedy
+           decode by construction; sampling rows accept proposal ``x``
+           with probability ``min(1, p_t(x)/p_d(x))`` and sample the
+           first rejection from ``norm(max(p_t - p_d, 0))`` — the
+           standard speculative-sampling estimator (target-distribution
+           exact), with every random draw keyed from the row's
+           per-position key schedule (``fold_in`` tags 1/2/3 for
+           draft/accept/residual), so output is reproducible per
+           (seed, row).
+
+        ``counts[i]`` ∈ [1, K] tokens emitted per active row (0 for
+        frozen rows); ``accepted[i]`` is the count of ACCEPTED draft
+        proposals — the honest acceptance-rate numerator (``counts``
+        includes the free correction token)."""
+        cache_key = (width, k_spec)
+        fn = self._spec_verify.get(cache_key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            from pygrid_tpu.models import decode
+
+            cfg, dcfg, cd = self.cfg, self.draft_cfg, self.compute_dtype
+
+            def _spec_verify(
+                params, dparams, k, v, pos, dk, dv, table, tokens,
+                active, temps, keys,
+            ):
+                keys_t = jnp.transpose(keys, (1, 0, 2))  # [K, w, 2]
+
+                def dbody(carry, step_keys):
+                    dkk, dvv, dpp, tok = carry
+                    dcache = decode.PagedKVCache(k=dkk, v=dvv, pos=dpp)
+                    dlogits, dcache = decode.paged_decode_step(
+                        dparams, dcache, table, tok, dcfg, cd,
+                        active=active,
+                    )
+                    draft_keys = jax.vmap(
+                        lambda kk: jax.random.fold_in(kk, 1)
+                    )(step_keys)
+                    proposal = jax.vmap(self._pick)(
+                        dlogits, temps, draft_keys
+                    )
+                    carry = (dcache.k, dcache.v, dcache.pos, proposal)
+                    return carry, (tok, proposal, dlogits)
+
+                (dkk, dvv, _dpp, _), (fed, props, dlg) = lax.scan(
+                    dbody, (dk, dv, pos, tokens), keys_t
+                )
+                cache = decode.PagedKVCache(k=k, v=v, pos=pos)
+                tlogits, cache = decode.paged_verify_chunk(
+                    params, cache, table, fed.T, cfg, cd, active=active
+                )  # [w, K, vocab]
+                X = props.T  # [w, K] proposal for emitted index j
+                D = jnp.transpose(dlg, (1, 0, 2))  # [w, K, vocab]
+                greedy_tok = jnp.argmax(tlogits, axis=-1).astype(
+                    jnp.int32
+                )  # [w, K]
+                safe_t = jnp.where(temps > 0.0, temps, jnp.float32(1.0))
+                p_t = jax.nn.softmax(tlogits / safe_t[:, None, None], -1)
+                p_d = jax.nn.softmax(D / safe_t[:, None, None], -1)
+                px_t = jnp.take_along_axis(p_t, X[:, :, None], -1)[..., 0]
+                px_d = jnp.take_along_axis(p_d, X[:, :, None], -1)[..., 0]
+
+                def fold2(tag):
+                    return jax.vmap(
+                        jax.vmap(lambda kk: jax.random.fold_in(kk, tag))
+                    )(keys)
+
+                u = jax.vmap(jax.vmap(jax.random.uniform))(fold2(2))
+                # u ≤ p_t/p_d, multiplied through: a zero draft prob
+                # (can't be sampled, but denormals happen) accepts
+                sampled_ok = u * px_d <= px_t
+                greedy_ok = X == greedy_tok
+                ok = jnp.where(
+                    temps[:, None] > 0.0, sampled_ok, greedy_ok
+                )
+                lead = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+                n_acc = lead.sum(axis=1)  # [w] accepted proposals
+                residual = jnp.clip(p_t - p_d, 0.0, None)
+                resid_tok = jax.vmap(
+                    jax.vmap(
+                        lambda kk, lg: jax.random.categorical(kk, lg)
+                    )
+                )(fold2(3), jnp.log(residual + 1e-20)).astype(jnp.int32)
+                corr = jnp.where(
+                    temps[:, None] > 0.0, resid_tok, greedy_tok
+                )
+                jidx = jnp.arange(X.shape[1])[None, :]
+                emitted = jnp.where(
+                    jidx < n_acc[:, None], X,
+                    jnp.where(jidx == n_acc[:, None], corr, 0),
+                )
+                counts = jnp.minimum(n_acc + 1, X.shape[1]).astype(
+                    jnp.int32
+                )
+                counts = jnp.where(active, counts, 0)
+                new_pos = cache.pos.at[: counts.shape[0]].add(counts)
+                return (
+                    emitted, n_acc.astype(jnp.int32), counts,
+                    cache.k, cache.v, new_pos, dkk, dvv,
+                )
+
+            fn = telemetry.profiler.wrap(
+                jax.jit(_spec_verify, donate_argnums=(2, 3, 4, 5, 6)),
+                kind="spec_verify", bucket=width,
+                model_id=self.model_id,
+            )
+            self._spec_verify[cache_key] = fn
+            self._count("spec_verify")
         return fn

@@ -128,6 +128,29 @@ _FAMILY_HELP: dict[str, str] = {
         "prompt tokens NOT re-prefilled thanks to prefix hits, by model"
     ),
     "serving_blocks_per_request": "KV pool blocks held per admitted request",
+    # fused multi-step + speculative decode (docs/SERVING.md)
+    "serving_fused_scans_total": (
+        "fused multi-step decode scans dispatched, by model"
+    ),
+    "serving_fused_steps_total": (
+        "device decode steps executed inside fused scans, by model"
+    ),
+    "serving_fused_wasted_steps_total": (
+        "frozen row-steps burned by rows finishing mid-scan, by model"
+    ),
+    "serving_spec_verifies_total": (
+        "speculative draft-propose + verify cycles, by model"
+    ),
+    "serving_spec_proposed_total": (
+        "draft tokens proposed for verification, by model"
+    ),
+    "serving_spec_accepted_total": (
+        "draft tokens accepted by the target model, by model — "
+        "accepted/proposed is the per-model acceptance rate"
+    ),
+    "slo_webhook_posts_total": (
+        "SLO breach-webhook deliveries, by objective and outcome"
+    ),
     # observability engine (telemetry/{profiler,recorder,slo}.py)
     "profiler_compile_seconds": "jitted-program calls that compiled, by kind",
     "profiler_execute_seconds": "jitted-program steady-state calls, by kind",

@@ -34,6 +34,8 @@ latency budget — rather than only dead.
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import threading
 import time
@@ -42,6 +44,8 @@ from dataclasses import dataclass, field
 from typing import Iterable
 
 from pygrid_tpu.telemetry import bus
+
+logger = logging.getLogger(__name__)
 
 #: short-window burn that pages (the classic 14.4 = 30-day budget gone
 #: in 2 days) and the long-window burn that confirms it is not a blip
@@ -158,6 +162,158 @@ def _good_count(snap: dict, threshold_s: float) -> int:
     return snap["count"]
 
 
+class BreachNotifier:
+    """Push-side SLO alerting: ONE webhook POST per objective STATUS
+    TRANSITION (``/telemetry/slo`` is pull-only; an operator who isn't
+    scraping still learns the moment an objective breaches — and the
+    moment it recovers).
+
+    Configured by ``PYGRID_SLO_WEBHOOK_URL`` (unset: the notifier is a
+    no-op — the default; nothing phones anywhere unasked). Transitions
+    involving ``warn``/``breach`` on either side post; ``no_data``⇄``ok``
+    churn (process start, idle families) is noise and does not. Each
+    objective is rate-limited (``PYGRID_SLO_WEBHOOK_MIN_S``, default
+    60 s) so a flapping objective cannot flood the receiver, and every
+    transition INTO ``breach`` attaches the flight recorder's crash
+    dump (ring + engine snapshots + counters — the state that explains
+    the breach) inline in the payload. Delivery runs on a daemon
+    thread: a slow or dead receiver never blocks ``evaluate()`` (which
+    handlers call on scrape paths). Outcomes land on
+    ``slo_webhook_posts_total{objective, outcome}``."""
+
+    def __init__(
+        self,
+        url: str | None = None,
+        min_interval_s: float | None = None,
+    ) -> None:
+        self.url = (
+            url
+            if url is not None
+            else os.environ.get("PYGRID_SLO_WEBHOOK_URL") or None
+        )
+        self.min_interval_s = (
+            min_interval_s
+            if min_interval_s is not None
+            else bus.env_float("PYGRID_SLO_WEBHOOK_MIN_S", 60.0)
+        )
+        self._lock = threading.Lock()
+        self._last_status: dict[str, str] = {}
+        self._last_post: dict[str, float] = {}
+
+    @staticmethod
+    def _worth_posting(prev: str, status: str) -> bool:
+        return "breach" in (prev, status) or "warn" in (prev, status)
+
+    def observe(self, rows: list[dict]) -> None:
+        """Feed one ``evaluate()`` result; fires POSTs for transitions.
+        Cheap when unconfigured (status tracking only).
+
+        ``_last_status`` tracks the last status the receiver was TOLD
+        about: a transition suppressed by the rate limit is retried on
+        the next evaluate tick (it stays pending) rather than dropped —
+        otherwise a breach→ok recovery landing inside the interval
+        would leave the operator's view showing a standing breach that
+        ended long ago. Flapping still converges: posts are bounded to
+        one per interval per objective, and the final stable state
+        always goes out once the interval clears."""
+        for row in rows:
+            name, status = row["name"], row["status"]
+            now = time.monotonic()
+            rate_limited = False
+            post = False
+            # ONE lock acquisition per row: a read-decide-update split
+            # would let two racing evaluate() callers both see the old
+            # status and double-post a single transition
+            with self._lock:
+                prev = self._last_status.get(name)
+                if prev is None or status == prev:
+                    self._last_status[name] = status
+                elif not self.url or not self._worth_posting(
+                    prev, status
+                ):
+                    self._last_status[name] = status
+                else:
+                    last = self._last_post.get(name)
+                    if last is not None and (
+                        now - last < self.min_interval_s
+                    ):
+                        # pending, not dropped: _last_status keeps the
+                        # last POSTED value so the next tick retries
+                        rate_limited = True
+                    else:
+                        self._last_post[name] = now
+                        self._last_status[name] = status
+                        post = True
+            if rate_limited:
+                bus.incr(
+                    "slo_webhook_posts_total", objective=name,
+                    outcome="rate_limited",
+                )
+            if not post:
+                continue
+            payload = {
+                "objective": name,
+                "from": prev,
+                "to": status,
+                "ts": time.time(),
+                "row": row,
+            }
+            threading.Thread(
+                target=self._post,
+                # the breach flight dump is BUILT on the delivery
+                # thread too — evaluate() runs on scrape handlers and
+                # the asyncio cadence loop, which must never wait on a
+                # crash-dump disk write
+                args=(name, payload, status == "breach"),
+                name=f"pygrid-slo-webhook-{name}",
+                daemon=True,
+            ).start()
+
+    @staticmethod
+    def _flight_dump(name: str, row: dict) -> dict | None:
+        """The flight recorder's crash dump for a breach, inline —
+        best-effort (an unwritable flight dir must not kill alerting)."""
+        try:
+            from pygrid_tpu.telemetry import recorder
+
+            recorder.note("slo.breach", objective=name)
+            path = recorder.dump(f"slo_breach_{name}", snapshot=row)
+            if path is None:
+                return None
+            with open(path, encoding="utf-8") as fh:
+                return json.load(fh)
+        except Exception:  # noqa: BLE001 — alert delivery > dump fidelity
+            logger.exception("SLO breach flight dump failed")
+            return None
+
+    def _post(
+        self, name: str, payload: dict, attach_dump: bool = False
+    ) -> None:
+        import urllib.request
+
+        if attach_dump:
+            payload["flight_dump"] = self._flight_dump(
+                name, payload.get("row") or {}
+            )
+        try:
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                outcome = "ok" if 200 <= resp.status < 300 else "error"
+        except Exception:  # noqa: BLE001 — receiver trouble is an outcome
+            logger.warning(
+                "SLO webhook POST for %s failed", name, exc_info=True
+            )
+            outcome = "error"
+        bus.incr(
+            "slo_webhook_posts_total", objective=name, outcome=outcome
+        )
+
+
 class SLOEngine:
     """Evaluates a fixed objective set against the process bus."""
 
@@ -173,6 +329,9 @@ class SLOEngine:
         self.windows = tuple(windows or windows_from_env())
         #: histogram source (the bus module by default; tests inject)
         self._source = source if source is not None else bus
+        #: push-side alerting: one POST per objective status transition
+        #: (no-op unless PYGRID_SLO_WEBHOOK_URL is set — §6)
+        self.notifier = BreachNotifier()
         self._lock = threading.Lock()
         self._snaps: deque[_Snapshot] = deque(maxlen=MAX_SNAPSHOTS)
         #: minimum spacing between RETAINED snapshots: evaluate() ticks
@@ -294,6 +453,10 @@ class SLOEngine:
             if obj.group_by:
                 row["by_" + obj.group_by] = self.group_burn(obj.name, now)
             out.append(row)
+        try:
+            self.notifier.observe(out)
+        except Exception:  # noqa: BLE001 — alerting must not break reads
+            logger.exception("SLO webhook notifier failed")
         return out
 
     def _status(

@@ -1,10 +1,13 @@
 #!/usr/bin/env sh
 # Serving-path smoke: tiny transformer, CPU only, no sockets — catches
-# continuous-batching throughput, paged-KV capacity, prefix-cache and
-# recompile regressions in seconds, without a TPU or a live node. The
-# same assertions run under tier-1 via tests/unit/test_bench_serving.py;
-# the full-size captures are bench.py's bench_serving() and
-# bench_serving_paged() sections (recorded into the round's BENCH file).
+# continuous-batching throughput, paged-KV capacity, prefix-cache,
+# fused-decode steady-state and recompile regressions in seconds,
+# without a TPU or a live node. The same assertions run under tier-1
+# via tests/unit/test_bench_serving.py; the full-size captures are
+# bench.py's bench_serving(), bench_serving_paged() and
+# bench_serving_fused() sections (recorded into the round's BENCH
+# file — the fused section also reports the speculative path's
+# acceptance rate and net ratio, honestly).
 #
 # Usage: scripts/bench_serving.sh [--full]
 set -e
@@ -13,8 +16,9 @@ TINY=True
 [ "$1" = "--full" ] && TINY=False
 JAX_PLATFORMS=cpu python -c "
 import json
-from bench import bench_serving, bench_serving_paged
+from bench import bench_serving, bench_serving_paged, bench_serving_fused
 out = bench_serving(tiny=$TINY)
 out.update(bench_serving_paged(tiny=$TINY))
+out.update(bench_serving_fused(tiny=$TINY))
 print(json.dumps(out, indent=2))
 "

@@ -19,6 +19,7 @@ from pygrid_tpu.analysis.checkers import (
     AsyncHygieneChecker,
     ContractDriftChecker,
     LockDisciplineChecker,
+    PallasBoundsChecker,
     TraceSafetyChecker,
 )
 
@@ -406,6 +407,254 @@ _GL2_RACY = """
         def racy_add(self, x):
             self._items.append(x)
 """
+
+
+class TestGL105:
+    """Python-scalar-into-traced-signature: per-request host ints baked
+    into a jitted program's STATIC surface (the ``n_new`` recompile
+    pathology PR 3 fixed)."""
+
+    def test_request_scalar_in_jit_lambda_default_fires(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def handler(msg, params, x):
+                n_new = int(msg["data"]["n_new"])
+                fn = jax.jit(lambda p, v, n=n_new: v[:n])
+                return fn(params, x)
+        """, TraceSafetyChecker)
+        assert "GL105" in _codes(res)
+
+    def test_request_scalar_free_in_named_def_fires(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def handler(payload, params, x):
+                n = payload.get("n")
+
+                def prog(p, v):
+                    return v[:n]
+
+                return jax.jit(prog)(params, x)
+        """, TraceSafetyChecker)
+        assert "GL105" in _codes(res)
+
+    def test_request_scalar_at_static_argnum_fires(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def step(v, n):
+                return v[:n]
+
+            def handler(request, x):
+                k = request.json["k"]
+                fn = jax.jit(step, static_argnums=(1,))
+                return fn(x, k)
+        """, TraceSafetyChecker)
+        assert "GL105" in _codes(res)
+
+    def test_taint_propagates_through_arithmetic(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+
+            def handler(msg, params, x):
+                raw = msg["n_new"]
+                padded = int(raw) + 7
+                return jax.jit(lambda p, v, n=padded: v[:n])(params, x)
+        """, TraceSafetyChecker)
+        assert "GL105" in _codes(res)
+
+    def test_traced_argument_and_host_loop_bound_stay_quiet(self, tmp_path):
+        """The FIXES stay clean: the scalar as a traced array value, or
+        as a host-side loop bound that never enters a trace."""
+        res = _lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(lambda v, n: v * n)
+
+            def handler(msg, x):
+                n_new = int(msg["data"]["n_new"])
+                out = []
+                for _ in range(n_new):
+                    out.append(step(x, jnp.int32(n_new)))
+                return out
+        """, TraceSafetyChecker)
+        assert "GL105" not in _codes(res)
+
+    def test_sink_in_nested_block_reports_once(self, tmp_path):
+        """A tainted jit inside if/try nesting is ONE finding, not one
+        per nesting level — baseline counts must not depend on depth."""
+        res = _lint(tmp_path, """
+            import jax
+
+            def handler(msg, params, x):
+                n_new = int(msg["data"]["n_new"])
+                if x is not None:
+                    try:
+                        fn = jax.jit(lambda p, v, n=n_new: v[:n])
+                        return fn(params, x)
+                    finally:
+                        pass
+        """, TraceSafetyChecker)
+        assert _codes(res).count("GL105") == 1
+
+    def test_nested_def_assigns_do_not_leak_taint(self, tmp_path):
+        """An assignment inside a nested def binds THAT scope — the
+        enclosing function's same-named parameter stays clean."""
+        res = _lint(tmp_path, """
+            import jax
+
+            def handler(x, n, params):
+                if x is not None:
+                    def helper(msg):
+                        n = int(msg["k"])
+                        return n
+
+                fn = jax.jit(lambda p, m=n: p * m)
+                return fn(params)
+        """, TraceSafetyChecker)
+        assert "GL105" not in _codes(res)
+
+    def test_assignment_after_sink_does_not_taint_it(self, tmp_path):
+        """Taint flows in statement order: a lambda that captured the
+        pristine value is clean even if the name is later rebound from
+        a request."""
+        res = _lint(tmp_path, """
+            import jax
+
+            def handler(msg, params, x):
+                n = 4
+                if x is not None:
+                    fn = jax.jit(lambda p, m=n: p * m)
+                n = int(msg["k"])
+                return n
+        """, TraceSafetyChecker)
+        assert "GL105" not in _codes(res)
+
+    def test_non_request_scalar_into_jit_stays_quiet(self, tmp_path):
+        """Config-derived statics are deliberate bucketing, not the
+        per-request pathology — no taint, no finding."""
+        res = _lint(tmp_path, """
+            import jax
+
+            BUCKET = 64
+
+            def build(params, x, width):
+                fn = jax.jit(lambda p, v, w=BUCKET: v[:w])
+                return fn(params, x)
+        """, TraceSafetyChecker)
+        assert "GL105" not in _codes(res)
+
+
+class TestGL5:
+    """Pallas grid/BlockSpec bounds: literal shape arithmetic checked
+    at lint time; dynamic shapes (the padded-kernel idiom) stay quiet."""
+
+    def test_block_not_dividing_out_shape_fires_GL501(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def run(x):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((32, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((32, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((100, 128), "float32"),
+                )(x)
+        """, PallasBoundsChecker)
+        assert "GL501" in _codes(res)
+
+    def test_index_map_arity_mismatch_fires_GL502(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def run(x):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(4, 2),
+                    in_specs=[pl.BlockSpec((32, 64), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((32, 64), lambda i, j: (i, j)),
+                    out_shape=jax.ShapeDtypeStruct((128, 128), "float32"),
+                )(x)
+        """, PallasBoundsChecker)
+        assert _codes(res) == ["GL502"]
+
+    def test_module_constant_arithmetic_resolves(self, tmp_path):
+        """Block/shape dims spelled through module constants and
+        arithmetic still resolve — and still fire when they don't
+        divide."""
+        res = _lint(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            BLOCK = 48
+            ROWS = 2 * 50
+
+            def kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def run(x):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(2,),
+                    out_specs=pl.BlockSpec((BLOCK,), lambda i: (i,)),
+                    out_shape=jax.ShapeDtypeStruct((ROWS,), "float32"),
+                )(x)
+        """, PallasBoundsChecker)
+        assert "GL501" in _codes(res)
+
+    def test_dividing_blocks_and_matching_arity_stay_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def run(x):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(4, 2),
+                    in_specs=[
+                        pl.BlockSpec((32, 64), lambda i, j: (i, j)),
+                    ],
+                    out_specs=pl.BlockSpec((32, 64), lambda i, j: (i, j)),
+                    out_shape=jax.ShapeDtypeStruct((128, 128), "float32"),
+                )(x)
+        """, PallasBoundsChecker)
+        assert _codes(res) == []
+
+    def test_dynamic_shapes_stay_quiet(self, tmp_path):
+        """The padded-kernel idiom (pallas_attention.py): block sizes
+        and shapes computed at runtime are out of static reach — no
+        guessing, no finding."""
+        res = _lint(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def run(x, block_q):
+                rows = (x.shape[0] + block_q - 1) // block_q * block_q
+                return pl.pallas_call(
+                    kernel,
+                    grid=(rows // block_q,),
+                    out_specs=pl.BlockSpec((block_q, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((rows, 128), "float32"),
+                )(x)
+        """, PallasBoundsChecker)
+        assert _codes(res) == []
 
 
 class TestGL2:

@@ -364,3 +364,148 @@ def test_paged_off_env_falls_back_to_contiguous(params, monkeypatch):
         np.testing.assert_array_equal(got, _ref(params, [[3, 5, 2]], 4))
     finally:
         eng.close()
+
+
+# ── live DeviceBudget re-partitioning (PR-7 follow-up) ───────────────────
+
+
+def test_block_pool_retire_takes_only_free_blocks():
+    pool = BlockPool(9)  # 8 usable
+    held = pool.alloc(3)
+    assert pool.retire(100) == 5  # only the free ones move
+    assert pool.usable == 3
+    assert pool.free_count() == 0
+    # retired blocks are poisoned: naming one is a refcount bug
+    with pytest.raises(RuntimeError):
+        pool.incref([8])
+    # live blocks are untouched and still release cleanly
+    pool.release(held)
+    assert pool.free_count() == 3
+
+
+def test_engine_shrink_reclaims_free_then_cached_never_live(params):
+    eng = _paged_engine(params, num_blocks=17)  # 16 usable, block=8
+    try:
+        # a completed request leaves its full prompt pages in the
+        # prefix cache (cache-only refs: reclaimable)
+        prompt = np.arange(1, 18, dtype=np.int32)[None, :]  # 17 toks
+        eng.submit(prompt, 2)
+        stats = eng.stats()
+        assert stats["kv_blocks_cached"] == 2
+        free_before = stats["kv_blocks_free"]  # 14
+        # ask for one MORE than free alone: an idle cached page must
+        # be evicted and given back too
+        assert eng.shrink_blocks(free_before + 1) == free_before + 1
+        stats = eng.stats()
+        assert stats["kv_blocks_total"] == 1
+        assert stats["kv_blocks_cached"] == 1
+        assert stats["kv_blocks_retired"] == free_before + 1
+        # the shrunken engine still serves (evicting the last cached
+        # page under pressure), bit-identically
+        got = eng.submit(np.array([[3, 5, 2]]), 4)
+        np.testing.assert_array_equal(got, _ref(params, [[3, 5, 2]], 4))
+    finally:
+        eng.close()
+
+
+def test_engine_shrink_cannot_touch_live_requests(params):
+    eng = _paged_engine(params, num_blocks=5)  # 4 usable
+    try:
+        # park a slow request so its pages stay live
+        fut = eng.enqueue(np.array([[1, 2, 3, 4, 5, 6, 7]]), 9)  # 2 pages
+        import time as _t
+
+        deadline = _t.monotonic() + 10
+        while eng.stats()["kv_blocks_free"] == 4:
+            assert _t.monotonic() < deadline
+            _t.sleep(0.005)
+        shrunk = eng.shrink_blocks(100)
+        # only the blocks NOT held by the live request retired
+        assert shrunk <= 2
+        assert fut.result(timeout=60).shape == (1, 9)
+    finally:
+        eng.close()
+
+
+def test_manager_repartitions_live_engines_on_late_registration(params):
+    """The PR-7 'min(share, remaining) forever' pathology closed: when
+    model B registers late against one PYGRID_KV_BUDGET, model A's
+    engine gives its RECLAIMABLE (free + idle-cached) blocks back and
+    B's grant is its true fair share, not the leftovers."""
+    from pygrid_tpu.datacentric.model_storage import HostedModel
+    from pygrid_tpu.serving import ServingManager
+
+    per_block = pagedkv.block_bytes(CFG, 16, jnp.float32)
+    budget = DeviceBudget(total_bytes=16 * per_block)
+    mgr = ServingManager(
+        EngineConfig(
+            max_slots=2, slot_buckets=(1, 2), min_prompt_bucket=8,
+            paged=True, block_size=16, cache_dtype=jnp.float32,
+        ),
+        budget=budget,
+    )
+    try:
+        hosted_a = HostedModel("model-a", decode.bundle(CFG, params))
+        eng_a = mgr.engine_for("model-a", hosted_a)
+        # alone, A holds the whole budget (16 blocks incl. trash)
+        assert eng_a.stats()["kv_blocks_total"] == 15
+        hosted_b = HostedModel("model-b", decode.bundle(CFG, params))
+        eng_b = mgr.engine_for("model-b", hosted_b)
+        # B's registration repartitioned A down to its fair half —
+        # live, without failing anything — and B got a real half,
+        # not min(share, nothing-left)
+        assert eng_a.stats()["kv_blocks_total"] == 7
+        assert eng_b.stats()["kv_blocks_total"] == 7
+        # both models still serve bit-identically after the shuffle
+        for eng in (eng_a, eng_b):
+            got = eng.submit(np.array([[3, 5, 2]]), 4)
+            np.testing.assert_array_equal(
+                got, _ref(params, [[3, 5, 2]], 4)
+            )
+    finally:
+        mgr.close()
+
+
+def test_budget_overage_and_record_shrink_ledger():
+    budget = DeviceBudget(total_bytes=1000, weights={"a": 1.0, "b": 1.0})
+    assert budget.blocks_for("a", 10) == 50  # a's half
+    # a is AT its share with b declared: no overage even before b runs
+    assert budget.overage("a") == 0
+    budget2 = DeviceBudget(total_bytes=1000)
+    assert budget2.blocks_for("a", 10) == 100  # alone: everything
+    # b joining halves a's fair share → 500 bytes over
+    assert budget2.overage("a", joining="b") == 500
+    budget2.record_shrink("a", 500)
+    assert budget2.overage("a", joining="b") == 0
+    # the freed bytes are grantable to b now
+    assert budget2.blocks_for("b", 10) == 50
+
+
+def test_shrink_realized_in_bytes_at_failure_recovery(params):
+    """shrink_blocks is logical (admission capacity) until the next
+    cache reallocation; a failure recovery must rebuild the device
+    arrays at the SHRUNKEN size — otherwise a budget give-back never
+    frees real HBM and the node runs over budget indefinitely."""
+    eng = _paged_engine(params, num_blocks=17)  # 16 usable
+    try:
+        assert eng.shrink_blocks(6) == 6
+        assert eng.stats()["kv_blocks_total"] == 10
+        original = eng.programs.paged_prefill
+
+        def boom(bucket):
+            raise RuntimeError("injected device failure")
+
+        eng.programs.paged_prefill = boom
+        with pytest.raises(E.PyGridError, match="engine error"):
+            eng.submit(np.array([[1, 2]]), 2, timeout=30)
+        eng.programs.paged_prefill = original
+        stats = eng.stats()
+        assert stats["kv_blocks_total"] == 10
+        # realized: the pool no longer carries retired placeholders...
+        assert stats["kv_blocks_retired"] == 0
+        # ...because the arrays themselves are smaller now (10 + trash)
+        assert eng._k.shape[1] == 11
+        got = eng.submit(np.array([[3, 5, 2]]), 4)
+        np.testing.assert_array_equal(got, _ref(params, [[3, 5, 2]], 4))
+    finally:
+        eng.close()

@@ -1,0 +1,231 @@
+"""SLO breach webhooks (telemetry/slo.py BreachNotifier,
+docs/OBSERVABILITY.md §6): ONE POST per objective status transition,
+flight-recorder dump attached on transitions into breach, per-objective
+rate limiting, and a hard no-op when no URL is configured —
+``/telemetry/slo`` was pull-only before this."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from pygrid_tpu.telemetry.slo import BreachNotifier, SLOEngine
+
+
+class _Receiver:
+    """A real local HTTP receiver capturing webhook payloads."""
+
+    def __init__(self):
+        captured = self.captured = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))
+                )
+                captured.append(json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}/hook"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def wait_for(self, count, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.captured) >= count:
+                return True
+            time.sleep(0.01)
+        return len(self.captured) >= count
+
+
+@pytest.fixture()
+def receiver():
+    r = _Receiver()
+    yield r
+    r.close()
+
+
+def _row(name, status, **extra):
+    return {
+        "name": name, "status": status, "family": f"{name}_seconds",
+        "compliance": 0.5, "burn": {"5m": 20.0}, **extra,
+    }
+
+
+def test_transition_posts_exactly_once_and_attaches_dump(receiver):
+    notifier = BreachNotifier(url=receiver.url, min_interval_s=0.0)
+    # first sighting establishes state — NO post (nothing transitioned)
+    notifier.observe([_row("ttft", "ok")])
+    # steady state — no post
+    notifier.observe([_row("ttft", "ok")])
+    assert not receiver.wait_for(1, timeout=0.3)
+    # ok → breach: exactly one POST, flight dump attached
+    notifier.observe([_row("ttft", "breach")])
+    assert receiver.wait_for(1)
+    # repeated breach evaluations are NOT new transitions
+    notifier.observe([_row("ttft", "breach")])
+    notifier.observe([_row("ttft", "breach")])
+    time.sleep(0.2)
+    assert len(receiver.captured) == 1
+    payload = receiver.captured[0]
+    assert payload["objective"] == "ttft"
+    assert payload["from"] == "ok" and payload["to"] == "breach"
+    assert payload["row"]["burn"] == {"5m": 20.0}
+    # breach transitions carry the flight recorder's dump (ring +
+    # stats + counters) inline — or an explicit null if the recorder
+    # is disabled in this environment, never a missing key
+    assert "flight_dump" in payload
+    # breach → ok recovery is a transition too
+    notifier.observe([_row("ttft", "ok")])
+    assert receiver.wait_for(2)
+    assert receiver.captured[1]["to"] == "ok"
+    # recovery posts don't drag a dump along
+    assert "flight_dump" not in receiver.captured[1]
+
+
+def test_rate_limit_is_per_objective(receiver):
+    notifier = BreachNotifier(url=receiver.url, min_interval_s=3600.0)
+    notifier.observe([_row("a", "ok"), _row("b", "ok")])
+    notifier.observe([_row("a", "breach"), _row("b", "ok")])
+    assert receiver.wait_for(1)
+    # 'a' flaps — inside the interval, suppressed
+    notifier.observe([_row("a", "ok"), _row("b", "ok")])
+    time.sleep(0.2)
+    assert len(receiver.captured) == 1
+    # 'b' breaching is a DIFFERENT objective: its own budget
+    notifier.observe([_row("a", "ok"), _row("b", "breach")])
+    assert receiver.wait_for(2)
+    assert receiver.captured[1]["objective"] == "b"
+
+
+def test_no_data_churn_stays_silent(receiver):
+    notifier = BreachNotifier(url=receiver.url, min_interval_s=0.0)
+    notifier.observe([_row("quiet", "no_data")])
+    notifier.observe([_row("quiet", "ok")])
+    notifier.observe([_row("quiet", "no_data")])
+    time.sleep(0.2)
+    assert receiver.captured == []
+
+
+def test_unconfigured_notifier_is_noop(monkeypatch):
+    monkeypatch.delenv("PYGRID_SLO_WEBHOOK_URL", raising=False)
+    notifier = BreachNotifier()
+    assert notifier.url is None
+    # transitions tracked, nothing fired, nothing raised
+    notifier.observe([_row("x", "ok")])
+    notifier.observe([_row("x", "breach")])
+
+
+def test_dead_receiver_never_raises_and_counts_error():
+    from pygrid_tpu import telemetry
+
+    notifier = BreachNotifier(
+        url="http://127.0.0.1:1/nope", min_interval_s=0.0
+    )
+    notifier.observe([_row("dead", "ok")])
+    notifier.observe([_row("dead", "breach")])  # must not raise
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        hits = [
+            v
+            for (name, labels), v in telemetry.counters().items()
+            if name == "slo_webhook_posts_total"
+            and dict(labels).get("objective") == "dead"
+            and dict(labels).get("outcome") == "error"
+        ]
+        if hits:
+            break
+        time.sleep(0.01)
+    assert hits, "failed delivery must land on the outcome counter"
+
+
+def test_engine_evaluate_feeds_the_notifier(receiver, monkeypatch):
+    """The wiring: SLOEngine.evaluate() → notifier.observe() — the
+    node/network cadence loops call evaluate, so a breach posts even
+    when nobody scrapes /telemetry/slo."""
+    from pygrid_tpu.telemetry.slo import Objective
+
+    class _Source:
+        """A cumulative histogram source: 50 observations per tick,
+        all good until ``bad`` flips, then all over threshold."""
+
+        def __init__(self):
+            self.count = 0
+            self.good = 0
+            self.bad = False
+
+        def histograms(self):
+            self.count += 50
+            if not self.bad:
+                self.good += 50
+            return {
+                ("lat_seconds", ()): {
+                    "count": self.count,
+                    "buckets": [
+                        (0.5, self.good), (float("inf"), self.count),
+                    ],
+                }
+            }
+
+    source = _Source()
+    engine = SLOEngine(
+        objectives=[
+            Objective(name="lat", family="lat_seconds", threshold_s=0.5)
+        ],
+        windows=(2.0, 10.0),
+        source=source,
+    )
+    engine.notifier = BreachNotifier(url=receiver.url, min_interval_s=0.0)
+    now = 1000.0
+    engine.evaluate(now)
+    now += 1.0
+    engine.evaluate(now)  # ok steady state
+    source.bad = True
+    # a short window of all-bad observations: burn blows past
+    # PAGE_BURN with MIN_EVENTS of support, long window confirms →
+    # breach transition → webhook
+    for _ in range(4):
+        now += 1.0
+        engine.evaluate(now)
+    # two transitions fire (ok→warn while the long window still
+    # confirms slowly, then warn→breach); delivery threads race, so
+    # assert the set, not the order
+    assert receiver.wait_for(2)
+    assert {c["objective"] for c in receiver.captured} == {"lat"}
+    assert {c["to"] for c in receiver.captured} == {"warn", "breach"}
+
+
+def test_rate_limited_transition_defers_not_drops(receiver):
+    """A transition suppressed by the rate limit stays PENDING and
+    posts on a later tick: a breach→ok recovery inside the interval
+    must not leave the receiver showing a standing breach forever."""
+    notifier = BreachNotifier(url=receiver.url, min_interval_s=0.4)
+    notifier.observe([_row("flap", "ok")])
+    notifier.observe([_row("flap", "breach")])
+    assert receiver.wait_for(1)
+    # recovery lands inside the interval: suppressed for now
+    notifier.observe([_row("flap", "ok")])
+    time.sleep(0.1)
+    assert len(receiver.captured) == 1
+    # the interval clears; the next evaluate tick retries the pending
+    # transition — the receiver converges to the truth
+    time.sleep(0.4)
+    notifier.observe([_row("flap", "ok")])
+    assert receiver.wait_for(2)
+    assert receiver.captured[1]["to"] == "ok"
