@@ -19,10 +19,16 @@ Checkers (see docs/ANALYSIS.md for the full rule catalogue):
 - **GL1 trace-safety** (GL101/GL102/GL103) — host side-effects inside
   functions passed to ``jax.jit``/``pjit``; ``.item()`` host syncs;
   jit-per-call recompile hazards.
-- **GL2 thread/lock discipline** (GL201/GL202/GL203) — lock-acquisition
-  -order cycles, mutation of lock-protected ``self._`` state outside
-  any ``with self._lock``, nested acquisition of an aliased
-  non-reentrant lock.
+- **GL2 thread/lock discipline** (GL201/GL202/GL203 per class;
+  GL204/GL205/GL206 whole-program) — lock-acquisition-order cycles,
+  mutation of lock-protected ``self._`` state outside any ``with
+  self._lock``, nested acquisition of an aliased non-reentrant lock;
+  plus the gridconc pass over the shared run-wide call graph
+  (``analysis/graph.py``): cross-module lock-order cycles with
+  canonical ``(owner class, attr)`` identity, blocking/heavy calls
+  while a lock is held weighted by inferred execution domain
+  (event-loop / worker thread / daemon / executor), and state written
+  from two domains with no common lock.
 - **GL3 async hygiene** (GL301/GL302/GL303) — blocking calls
   (``time.sleep``, sync sockets/requests, ``Future.result()``,
   unbounded ``queue.get()``, megabyte serde) on the event loop inside

@@ -6,14 +6,19 @@ cross-file state between ``check_module`` and ``finalize``)."""
 from __future__ import annotations
 
 from pygrid_tpu.analysis.checkers.gl1_trace import TraceSafetyChecker
+from pygrid_tpu.analysis.checkers.gl2_conc import ConcurrencyGraphChecker
 from pygrid_tpu.analysis.checkers.gl2_locks import LockDisciplineChecker
 from pygrid_tpu.analysis.checkers.gl3_async import AsyncHygieneChecker
 from pygrid_tpu.analysis.checkers.gl4_contracts import ContractDriftChecker
 from pygrid_tpu.analysis.checkers.gl5_pallas import PallasBoundsChecker
 
+#: two classes share the GL2 family: the per-class lock rules
+#: (GL201–203) and the whole-program concurrency pass (GL204–206) —
+#: ``--select GL2`` runs both
 ALL_CHECKERS = (
     TraceSafetyChecker,
     LockDisciplineChecker,
+    ConcurrencyGraphChecker,
     AsyncHygieneChecker,
     ContractDriftChecker,
     PallasBoundsChecker,
@@ -22,6 +27,7 @@ ALL_CHECKERS = (
 __all__ = [
     "ALL_CHECKERS",
     "AsyncHygieneChecker",
+    "ConcurrencyGraphChecker",
     "ContractDriftChecker",
     "LockDisciplineChecker",
     "PallasBoundsChecker",

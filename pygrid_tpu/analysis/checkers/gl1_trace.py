@@ -44,9 +44,18 @@ import ast
 from typing import Iterable
 
 from pygrid_tpu.analysis.core import Checker, Finding, ModuleContext
+from pygrid_tpu.analysis.graph import (
+    FunctionIndex as _FunctionIndex,
+    ImportIndex as _ImportIndexBase,
+    dotted as _dotted,
+    is_jit_callable as _is_jit_callable,
+    module_dotted as _module_dotted,
+    package_of as _package_of,
+)
 
-#: call spellings that enter a trace
-_JIT_NAMES = {"jit", "pjit"}
+#: the per-module symbol tables live in analysis/graph.py now (the
+#: whole-program core shares them with the GL2 concurrency checkers);
+#: the aliases above keep this module's historical local names
 
 #: ``module.attr`` calls that are host side-effects (GL101)
 _SIDE_EFFECT_ATTRS = {
@@ -62,132 +71,6 @@ _LOGGER_RECEIVERS = {"logger", "logging", "log"}
 _LOGGER_METHODS = {
     "debug", "info", "warning", "error", "exception", "critical", "log",
 }
-
-
-def _dotted(node: ast.AST) -> str | None:
-    """``a.b.c`` → "a.b.c" for Name/Attribute chains, else None."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _is_jit_callable(node: ast.AST) -> bool:
-    """Does this expression name ``jit``/``pjit`` (bare or dotted)?"""
-    dotted = _dotted(node)
-    if dotted is None:
-        return False
-    return dotted.split(".")[-1] in _JIT_NAMES
-
-
-def _jit_call_target(call: ast.Call) -> ast.AST | None:
-    """The function being jitted, if ``call`` is ``jit(fn, ...)``."""
-    if _is_jit_callable(call.func) and call.args:
-        return call.args[0]
-    return None
-
-
-class _FunctionIndex(ast.NodeVisitor):
-    """Module-level defs, class methods, and which are jitted."""
-
-    def __init__(self) -> None:
-        # qualified name -> def node. Module funcs: "f"; methods: "C.f".
-        self.defs: dict[str, ast.AST] = {}
-        self.jitted: list[tuple[ast.AST, str]] = []  # (fn node, how)
-        self._class_stack: list[str] = []
-
-    def _qual(self, name: str) -> str:
-        return (
-            f"{self._class_stack[-1]}.{name}" if self._class_stack else name
-        )
-
-    def visit_ClassDef(self, node: ast.ClassDef) -> None:
-        self._class_stack.append(node.name)
-        self.generic_visit(node)
-        self._class_stack.pop()
-
-    def _visit_def(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
-        self.defs[self._qual(node.name)] = node
-        for deco in node.decorator_list:
-            target = deco
-            if isinstance(deco, ast.Call):
-                # @partial(jax.jit, ...) / @jax.jit(...)
-                if _is_jit_callable(deco.func):
-                    self.jitted.append((node, "decorator"))
-                    break
-                fn_dotted = _dotted(deco.func)
-                if fn_dotted and fn_dotted.split(".")[-1] == "partial":
-                    if any(_is_jit_callable(a) for a in deco.args[:1]):
-                        self.jitted.append((node, "partial decorator"))
-                        break
-                continue
-            if _is_jit_callable(target):
-                self.jitted.append((node, "decorator"))
-                break
-        self.generic_visit(node)
-
-    visit_FunctionDef = _visit_def
-    visit_AsyncFunctionDef = _visit_def
-
-    def visit_Call(self, node: ast.Call) -> None:
-        target = _jit_call_target(node)
-        if target is not None:
-            if isinstance(target, ast.Lambda):
-                self.jitted.append((target, "jit(lambda)"))
-            else:
-                dotted = _dotted(target)
-                if dotted is not None:
-                    self.jitted.append((dotted, "jit(name)"))  # resolve later
-        self.generic_visit(node)
-
-
-def _module_dotted(rel_path: str) -> str:
-    """``pygrid_tpu/models/decode.py`` → ``pygrid_tpu.models.decode``;
-    ``pkg/__init__.py`` → ``pkg``."""
-    parts = rel_path[:-3].split("/") if rel_path.endswith(".py") else (
-        rel_path.split("/")
-    )
-    if parts and parts[-1] == "__init__":
-        parts = parts[:-1]
-    return ".".join(parts)
-
-
-class _ImportIndex(ast.NodeVisitor):
-    """Every import binding in one file (any scope — this repo imports
-    lazily inside function bodies): ``aliases`` maps a local name to the
-    dotted module it stands for, ``symbols`` maps a local name to
-    ``(dotted_module, original_name)`` for from-imports."""
-
-    def __init__(self, package: str) -> None:
-        self.package = package  # dotted package of the current module
-        self.aliases: dict[str, str] = {}
-        self.symbols: dict[str, tuple[str, str]] = {}
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            local = alias.asname or alias.name.split(".")[0]
-            # ``import a.b`` binds ``a``; ``import a.b as c`` binds c→a.b
-            self.aliases[local] = (
-                alias.name if alias.asname else alias.name.split(".")[0]
-            )
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        base = node.module or ""
-        if node.level:
-            # relative import: walk up from the current package
-            parts = self.package.split(".") if self.package else []
-            parts = parts[: len(parts) - (node.level - 1)]
-            base = ".".join(parts + ([node.module] if node.module else []))
-        for alias in node.names:
-            local = alias.asname or alias.name
-            # ``from pkg import mod`` may bind a MODULE — record it both
-            # ways; resolution tries the module table first
-            self.aliases.setdefault(local, f"{base}.{alias.name}")
-            self.symbols[local] = (base, alias.name)
 
 
 class _TraceBodyScan(ast.NodeVisitor):
@@ -763,7 +646,7 @@ class TraceSafetyChecker(Checker):
         # per-file state feeding the whole-run (cross-module) second
         # pass in finalize; keyed by rel_path
         self._indexes: dict[str, _FunctionIndex] = {}
-        self._imports: dict[str, _ImportIndex] = {}
+        self._imports: dict[str, _ImportIndexBase] = {}
         self._mods: dict[str, ModuleContext] = {}
         self._roots: dict[str, list[ast.AST]] = {}
         #: (path, line, code) already reported by the module-local pass —
@@ -773,16 +656,22 @@ class TraceSafetyChecker(Checker):
         self._dotted_to_rel: dict[str, str] = {}
 
     def check_module(self, mod: ModuleContext) -> Iterable[Finding]:
-        index = _FunctionIndex()
-        index.visit(mod.tree)
-        dotted = _module_dotted(mod.rel_path)
-        package = (
-            dotted
-            if mod.rel_path.endswith("__init__.py")
-            else (dotted.rsplit(".", 1)[0] if "." in dotted else "")
+        # the shared whole-program graph owns the symbol tables (built
+        # once per run); a hand-built ModuleContext (no runner) falls
+        # back to a local build so the checker stays unit-usable
+        syms = (
+            mod.runner.graph().modules.get(mod.rel_path)
+            if mod.runner is not None
+            else None
         )
-        imports = _ImportIndex(package)
-        imports.visit(mod.tree)
+        if syms is not None:
+            index = syms.index
+            imports = syms.imports
+        else:
+            index = _FunctionIndex()
+            index.visit(mod.tree)
+            imports = _ImportIndexBase(_package_of(mod.rel_path))
+            imports.visit(mod.tree)
         self._indexes[mod.rel_path] = index
         self._imports[mod.rel_path] = imports
         self._mods[mod.rel_path] = mod

@@ -25,11 +25,19 @@ for it). Three grades:
   call hiding one hop down blocks every socket just as surely. The
   closure is deliberately one hop (like GL1's module-local closure):
   helpers merely *referenced* (handed to ``run_in_executor`` /
-  ``_off_loop``) are not calls and stay exempt.
+  ``_off_loop``) are not calls and stay exempt. A sync helper defined
+  INSIDE the async body rides the executor-fodder exemption only while
+  it is merely referenced — if the body ALSO calls it directly, it
+  runs on the loop and is scanned like any other one-hop helper.
 
 Only code that executes ON the loop is flagged: nested sync ``def``s
 and ``lambda``s inside an async handler are exempt (they are what you
-hand to ``run_in_executor``).
+hand to ``run_in_executor``) — unless the same body calls them
+directly, see GL304.
+
+The blocking/heavy pattern tables (GL301–303) and their classifier
+live in :mod:`pygrid_tpu.analysis.graph` — GL205 applies the SAME set
+to lock-held regions in any execution domain.
 """
 
 from __future__ import annotations
@@ -38,51 +46,7 @@ import ast
 from typing import Iterable
 
 from pygrid_tpu.analysis.core import Checker, Finding, ModuleContext
-from pygrid_tpu.analysis.checkers.gl1_trace import _dotted
-
-#: (receiver, method) → GL301
-_BLOCKING_ATTRS = {
-    ("time", "sleep"): "time.sleep() parks the event loop",
-    ("requests", "get"): "sync HTTP on the event loop",
-    ("requests", "post"): "sync HTTP on the event loop",
-    ("requests", "put"): "sync HTTP on the event loop",
-    ("requests", "delete"): "sync HTTP on the event loop",
-    ("requests", "request"): "sync HTTP on the event loop",
-    ("requests", "head"): "sync HTTP on the event loop",
-    ("urllib.request", "urlopen"): "sync HTTP on the event loop",
-    ("socket", "create_connection"): "sync socket I/O on the event loop",
-    ("subprocess", "run"): "subprocess wait on the event loop",
-    ("subprocess", "call"): "subprocess wait on the event loop",
-    ("subprocess", "check_call"): "subprocess wait on the event loop",
-    ("subprocess", "check_output"): "subprocess wait on the event loop",
-    ("os", "system"): "subprocess wait on the event loop",
-}
-
-#: socket-object methods — flagged on any receiver named like a socket
-_SOCKET_METHODS = {"recv", "recv_into", "accept", "connect", "sendall"}
-
-#: queue-ish receiver names for the GL302 ``.get()`` rule
-_QUEUEISH = ("queue", "_q",)
-
-#: repo-known blocking callables (GL303): bare-name or attr spellings
-_REPO_BLOCKING = {
-    "serialize": "serde serialize() of model-scale payloads",
-    "deserialize": "serde deserialize() of model-scale payloads",
-    "to_hex": "serde hex encode of model-scale payloads",
-    "from_hex": "serde hex decode of model-scale payloads",
-    "b64decode": "base64 decode of model-scale payloads",
-    "b64encode": "base64 encode of model-scale payloads",
-    "b64_decode": "native base64 decode of model-scale payloads",
-    "encode_frame": "wire-v2 frame compression",
-    "decode_frame": "wire-v2 frame decompression",
-    "decode_frame_traced": "wire-v2 frame decompression",
-    # sync WS event handlers bridged into async HTTP routes: these
-    # decode/aggregate megabyte FL payloads synchronously
-    "ws_report": "sync WS report handler (megabyte diff decode)",
-    "ws_cycle_request": "sync WS cycle-request handler (DB + assign)",
-    "ws_authenticate": "sync WS authenticate handler (DB + JWT verify)",
-}
-
+from pygrid_tpu.analysis.graph import classify_blocking_call
 
 class _AsyncBodyScan(ast.NodeVisitor):
     """Walk one async function body WITHOUT descending into nested sync
@@ -96,9 +60,14 @@ class _AsyncBodyScan(ast.NodeVisitor):
         #: as arguments are not calls and land in neither set
         self.called_names: set[str] = set()       # bare ``helper(...)``
         self.called_methods: set[str] = set()     # ``self/cls.m(...)``
+        #: sync defs nested in THIS body — executor fodder unless the
+        #: same body also calls them directly (the GL304 nested-def hop)
+        self.nested_defs: dict[str, ast.FunctionDef] = {}
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        return  # sync helper: runs off-loop (executor fodder)
+        # sync helper: runs off-loop (executor fodder) — but remember
+        # it; a direct call in this same body puts it ON the loop
+        self.nested_defs.setdefault(node.name, node)
 
     def visit_Lambda(self, node: ast.Lambda) -> None:
         return
@@ -110,69 +79,14 @@ class _AsyncBodyScan(ast.NodeVisitor):
         fn = node.func
         if isinstance(fn, ast.Name):
             self.called_names.add(fn.id)
-            reason = _REPO_BLOCKING.get(fn.id)
-            if reason is not None:
-                self.hits.append(
-                    (node, "GL303", f"'{fn.id}()' — {reason}")
-                )
         elif isinstance(fn, ast.Attribute):
             if isinstance(fn.value, ast.Name) and fn.value.id in (
                 "self", "cls",
             ):
                 self.called_methods.add(fn.attr)
-            dotted = _dotted(fn) or f"?.{fn.attr}"
-            recv = dotted.rsplit(".", 1)[0]
-            hit = _BLOCKING_ATTRS.get((recv, fn.attr))
-            if hit is not None:
-                self.hits.append((node, "GL301", f"'{dotted}()' — {hit}"))
-            elif fn.attr in _SOCKET_METHODS and "sock" in recv.lower():
-                self.hits.append(
-                    (
-                        node,
-                        "GL301",
-                        f"'{dotted}()' — sync socket I/O on the event loop",
-                    )
-                )
-            elif fn.attr == "result":
-                self.hits.append(
-                    (
-                        node,
-                        "GL302",
-                        f"'{dotted}()' — Future.result() parks the loop; "
-                        "await asyncio.wrap_future(...) instead",
-                    )
-                )
-            elif fn.attr == "join" and "thread" in recv.lower():
-                self.hits.append(
-                    (
-                        node,
-                        "GL302",
-                        f"'{dotted}()' — thread join parks the loop",
-                    )
-                )
-            elif (
-                fn.attr == "get"
-                and any(q in recv.lower().split(".")[-1] for q in _QUEUEISH)
-                # any argument bounds or unblocks it: get(timeout),
-                # get(block=False), get_nowait — only the bare call waits
-                # forever
-                and not node.args
-                and not node.keywords
-            ):
-                self.hits.append(
-                    (
-                        node,
-                        "GL302",
-                        f"'{dotted}()' — unbounded queue.get() parks the "
-                        "loop",
-                    )
-                )
-            else:
-                reason = _REPO_BLOCKING.get(fn.attr)
-                if reason is not None:
-                    self.hits.append(
-                        (node, "GL303", f"'{dotted}()' — {reason}")
-                    )
+        hit = classify_blocking_call(node)
+        if hit is not None:
+            self.hits.append((node, hit[0], hit[1]))
         self.generic_visit(node)
 
 
@@ -247,9 +161,13 @@ class AsyncHygieneChecker(Checker):
                 )
             # one-hop closure: direct calls to same-module sync helpers
             # (bare names → module functions; self./cls. → this class's
-            # own methods, never another class's same-named one)
+            # own methods, never another class's same-named one). A
+            # nested def SHADOWS a same-named module helper and — when
+            # called directly in this body — loses its executor-fodder
+            # exemption: it runs on the loop (ROADMAP "GL304 nested-def
+            # hop").
             resolved = [
-                helpers.module_defs.get(n)
+                scan.nested_defs.get(n) or helpers.module_defs.get(n)
                 for n in sorted(scan.called_names)
             ]
             if class_name is not None:

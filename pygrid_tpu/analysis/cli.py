@@ -46,7 +46,15 @@ def _build_parser() -> argparse.ArgumentParser:
         help="stale baseline entries fail the run",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--changed", action="store_true",
+        help="analyze only files changed per git (diff vs HEAD + "
+        "untracked) plus their call-graph dependents — the fast "
+        "pre-commit loop",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "github"), default="text",
+        help="github: one ::warning file=…,line=…:: annotation per "
+        "finding, for CI inline surfacing",
     )
     parser.add_argument(
         "--list-checkers", action="store_true",
@@ -68,6 +76,78 @@ def _list_checkers() -> str:
     return "\n".join(lines)
 
 
+def _git_changed_files(root: str) -> set[str] | None:
+    """Repo-relative POSIX paths of changed .py files: ``git diff
+    --name-only HEAD`` (staged + unstaged) plus untracked. None when
+    git is unavailable (not a repo, no binary) — callers treat that as
+    a usage error, not an empty change set."""
+    import os
+    import subprocess
+
+    out: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(
+            line.strip()
+            for line in proc.stdout.splitlines()
+            if line.strip().endswith(".py")
+        )
+    return out
+
+
+def _changed_closure(targets: list[str]) -> list[str] | None | str:
+    """The ``--changed`` target set: changed files under ``targets``
+    plus their transitive reverse-import dependents (a changed callee
+    can flip a caller's cross-module findings). Returns the file list,
+    ``[]`` for "nothing changed", or an error string."""
+    import os
+
+    from pygrid_tpu.analysis.core import _infer_root, _iter_py_files
+    from pygrid_tpu.analysis.graph import import_dependents
+
+    root = _infer_root(targets)
+    changed = _git_changed_files(root)
+    if changed is None:
+        return "--changed needs a git work tree (git diff failed)"
+    files = _iter_py_files(targets)
+    by_rel = {
+        os.path.relpath(p, root).replace(os.sep, "/"): p for p in files
+    }
+    keep = import_dependents(
+        files,
+        lambda p: os.path.relpath(p, root).replace(os.sep, "/"),
+        set(changed),
+    )
+    return [by_rel[rel] for rel in sorted(keep) if rel in by_rel]
+
+
+def _github_annotations(result) -> list[str]:
+    """One workflow-command annotation per finding — GitHub renders
+    them inline on the PR diff."""
+    lines = []
+    for err in result.parse_errors:
+        lines.append(f"::error title=gridlint parse error::{err}")
+    for f in result.failures:
+        message = f.message.replace("%", "%25").replace(
+            "\r", "%0D"
+        ).replace("\n", "%0A")
+        lines.append(
+            f"::warning file={f.path},line={f.line},col={f.col + 1},"
+            f"title=gridlint {f.code}::{message}"
+        )
+    return lines
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.list_checkers:
@@ -84,6 +164,18 @@ def main(argv: list[str] | None = None) -> int:
             f"no such target(s): {', '.join(missing)}", file=sys.stderr
         )
         return 2
+
+    targets = list(args.targets)
+    if args.changed:
+        closure = _changed_closure(targets)
+        if isinstance(closure, str):
+            print(closure, file=sys.stderr)
+            return 2
+        if not closure:
+            if not args.quiet:
+                print("gridlint --changed: no python changes")
+            return 0
+        targets = closure
 
     checkers = [cls() for cls in ALL_CHECKERS]
     if args.select:
@@ -107,13 +199,28 @@ def main(argv: list[str] | None = None) -> int:
 
     t0 = time.perf_counter()
     result = run_checks(
-        args.targets, checkers=checkers, baseline_path=baseline_path
+        targets, checkers=checkers, baseline_path=baseline_path
     )
     elapsed = time.perf_counter() - t0
 
     failed = bool(result.failures or result.parse_errors) or (
         args.strict_baseline and bool(result.stale_baseline)
     )
+
+    if args.format == "github":
+        for line in _github_annotations(result):
+            print(line)
+        for note in result.stale_baseline:
+            print(f"::notice title=gridlint stale baseline::{note}")
+        if not args.quiet:
+            print(
+                f"gridlint: {result.files_checked} files, "
+                f"{len(result.failures)} finding(s), "
+                f"{len(result.stale_baseline)} stale baseline entr"
+                f"{'y' if len(result.stale_baseline) == 1 else 'ies'} "
+                f"in {elapsed:.2f}s"
+            )
+        return 1 if failed else 0
 
     if args.format == "json":
         print(

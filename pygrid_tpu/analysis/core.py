@@ -55,12 +55,23 @@ class Finding:
 class ModuleContext:
     """Everything a checker needs about one parsed file."""
 
-    def __init__(self, path: str, rel_path: str, source: str, tree: ast.Module):
+    def __init__(
+        self,
+        path: str,
+        rel_path: str,
+        source: str,
+        tree: ast.Module,
+        runner: "Runner | None" = None,
+    ):
         self.path = path
         self.rel_path = rel_path
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
+        #: the owning Runner — checkers reach the shared whole-program
+        #: graph through it (``mod.runner.graph()``); None only when a
+        #: test constructs a ModuleContext by hand
+        self.runner = runner
 
     def finding(
         self, code: str, node: ast.AST, message: str
@@ -218,6 +229,21 @@ class Runner:
         self.root = str(root) if root else os.getcwd()
         self.exclude = list(exclude)
         self.modules: list[ModuleContext] = []
+        self._graph = None
+
+    def graph(self):
+        """The shared whole-program graph (symbol table + call graph +
+        execution domains, :mod:`pygrid_tpu.analysis.graph`), built
+        LAZILY on first use and exactly once per run — every checker
+        that needs cross-module state rides this one artifact. Valid
+        once the parse phase of :meth:`run` has populated
+        ``self.modules`` (i.e. from any ``check_module``/``finalize``
+        hook)."""
+        if self._graph is None:
+            from pygrid_tpu.analysis.graph import ProgramGraph
+
+            self._graph = ProgramGraph(self.modules)
+        return self._graph
 
     def _rel(self, path: str) -> str:
         try:
@@ -234,6 +260,9 @@ class Runner:
     ) -> RunResult:
         result = RunResult()
         raw_findings: list[tuple[ModuleContext | None, Finding]] = []
+        # phase 1: parse EVERY file before any checker runs, so the
+        # whole-program graph (``self.graph()``) is complete from the
+        # first ``check_module`` call
         for path in _iter_py_files(targets):
             rel = self._rel(path)
             if self._excluded(rel):
@@ -254,9 +283,10 @@ class Runner:
             except SyntaxError as err:
                 result.parse_errors.append(f"{rel}: syntax error: {err}")
                 continue
-            mod = ModuleContext(path, rel, source, tree)
-            self.modules.append(mod)
+            self.modules.append(ModuleContext(path, rel, source, tree, self))
             result.files_checked += 1
+        # phase 2: per-module checks
+        for mod in self.modules:
             for checker in self.checkers:
                 for f in checker.check_module(mod):
                     raw_findings.append((mod, f))

@@ -774,10 +774,15 @@ class CycleManager:
             )
         )
         open_cycle = self.last(pid)
+        # encode OUTSIDE the fold lock: the envelope is a pure function
+        # of the arguments, but msgpacking a model-scale diff takes
+        # milliseconds — holding _accum_lock through it stalls every
+        # concurrent report's fold (gridlint GL205). The row write +
+        # fold stay one atomic step against the flush, which reads
+        # unflushed rows and pops the accumulator under this same lock.
+        envelope = encode_partial_envelope(diff, count, ws)
         with self._accum_lock:
-            self._mark_partial_rows(
-                wcs, encode_partial_envelope(diff, count, ws)
-            )
+            self._mark_partial_rows(wcs, envelope)
             acc = self._async_accum.setdefault(pid, _DiffAccumulator())
             acc.add_partial_raw(raws, count, ws, scale=scale)
         tasks.run_task_once(

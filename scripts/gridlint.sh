@@ -3,6 +3,21 @@
 # Runs the full suite over pygrid_tpu/ against the committed baseline;
 # exits non-zero on any non-baselined finding. Tier-1 runs the same
 # suite in-process via tests/unit/test_gridlint_clean.py.
+#
+#   scripts/gridlint.sh                # full tree, strict baseline
+#   scripts/gridlint.sh --changed      # git-changed files + their
+#                                      # call-graph dependents (the
+#                                      # fast pre-commit loop)
+#
+# Under GitHub Actions the findings are emitted as ::warning
+# annotations (one per finding) so CI surfaces them inline on the PR —
+# pass an explicit --format to override.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [ "${GITHUB_ACTIONS:-}" = "true" ]; then
+  case " $* " in
+    *" --format"*) ;;
+    *) set -- --format github "$@" ;;
+  esac
+fi
 exec python -m pygrid_tpu.analysis --strict-baseline "$@"

@@ -1,0 +1,297 @@
+"""The whole-program analysis core (analysis/graph.py) and the real
+GL205 fix it bought.
+
+Part 1 exercises the graph directly — symbol resolution through
+singleton/bound-method/re-export chains, typed-collaborator call
+edges, and execution-domain inference — because the GL204–206 checkers
+are only as good as these tables.
+
+Part 2 is the regression test for the product fix the first GL205 run
+produced: ``CycleManager._submit_async_partial`` msgpacked a
+model-scale partial envelope INSIDE ``_accum_lock`` (the sync door
+encodes outside it), stalling every concurrent report's fold for the
+duration of a megabyte serde. The encode now runs before the lock; the
+row write + fold stay one atomic step against the flush.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+
+import numpy as np
+
+from pygrid_tpu.analysis.core import Runner
+
+
+def _graph(tmp_path, files):
+    (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+    for path, text in files.items():
+        f = tmp_path / path
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(text))
+    runner = Runner([], root=str(tmp_path))
+    runner.run([str(tmp_path)])
+    return runner.graph()
+
+
+class TestResolution:
+    def test_bound_method_reexport_chain_resolves(self, tmp_path):
+        """The telemetry shape: ``pkg.incr`` → ``__init__`` from-import
+        → ``bus.incr = BUS.incr`` bound method → ``Bus.incr``."""
+        g = _graph(tmp_path, {
+            "pkg/__init__.py": "from pkg.bus import incr\n",
+            "pkg/bus.py": """
+                import threading
+
+                class Bus:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def incr(self, name):
+                        with self._lock:
+                            pass
+
+                BUS = Bus()
+                incr = BUS.incr
+            """,
+            "pkg/mgr.py": """
+                import pkg
+
+                def work():
+                    pkg.incr("x")
+            """,
+        })
+        work = g.functions[("pkg/mgr.py", "work")]
+        targets = [t for c in work.calls for t in c.targets]
+        assert ("pkg/bus.py", "Bus.incr") in targets
+
+    def test_typed_collaborator_attr_call_resolves(self, tmp_path):
+        g = _graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/bus.py": """
+                class Bus:
+                    def record(self):
+                        pass
+            """,
+            "pkg/mgr.py": """
+                from pkg.bus import Bus
+
+                class Manager:
+                    def __init__(self, bus: Bus):
+                        self._bus = bus
+
+                    def note(self):
+                        self._bus.record()
+            """,
+        })
+        note = g.functions[("pkg/mgr.py", "Manager.note")]
+        targets = [t for c in note.calls for t in c.targets]
+        assert ("pkg/bus.py", "Bus.record") in targets
+
+    def test_constructed_attr_type_resolves(self, tmp_path):
+        g = _graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/pool.py": """
+                class BlockPool:
+                    def release(self, pages):
+                        pass
+            """,
+            "pkg/engine.py": """
+                from pkg import pool as pagedkv
+
+                class Engine:
+                    def __init__(self, n):
+                        self._pool = pagedkv.BlockPool(n)
+
+                    def free(self, pages):
+                        self._pool.release(pages)
+            """,
+        })
+        free = g.functions[("pkg/engine.py", "Engine.free")]
+        targets = [t for c in free.calls for t in c.targets]
+        assert ("pkg/pool.py", "BlockPool.release") in targets
+
+
+class TestDomains:
+    def test_entry_points_and_propagation(self, tmp_path):
+        g = _graph(tmp_path, {
+            "pkg/app.py": """
+                import threading
+
+                def helper():
+                    pass
+
+                async def route(loop):
+                    helper()
+                    await loop.run_in_executor(None, offloaded)
+
+                def offloaded():
+                    pass
+
+                def never_called():
+                    pass
+
+                class Engine:
+                    def start(self):
+                        self._t = threading.Thread(target=self._run)
+                        self._s = threading.Thread(
+                            target=self._snap, daemon=True
+                        )
+
+                    def _run(self):
+                        helper()
+
+                    def _snap(self):
+                        pass
+            """,
+        })
+        d = lambda q: g.domains_of(("pkg/app.py", q))
+        assert d("route") == {"loop"}
+        assert d("offloaded") == {"executor"}
+        assert d("Engine._run") == {"thread"}
+        assert d("Engine._snap") == {"daemon"}
+        # helper is called from the loop AND the worker thread
+        assert d("helper") == {"loop", "thread"}
+        assert d("never_called") == set()
+
+    def test_async_callee_of_a_thread_stays_loop(self, tmp_path):
+        """Calling an async def from a thread only SCHEDULES it — the
+        thread domain must not leak into coroutine bodies."""
+        g = _graph(tmp_path, {
+            "pkg/app.py": """
+                import threading
+
+                async def coro():
+                    pass
+
+                class Engine:
+                    def start(self):
+                        self._t = threading.Thread(target=self._run)
+
+                    def _run(self):
+                        coro()
+            """,
+        })
+        assert g.domains_of(("pkg/app.py", "coro")) == {"loop"}
+
+    def test_graph_sees_repo_scale_entry_points(self):
+        """On the real tree: the serving engine's device loop is a
+        worker thread, the WS routes are loop, the snapshot cadence is
+        a daemon — the inference the GL205/GL206 weighting rides."""
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parents[2]
+        runner = Runner([], root=str(repo))
+        runner.run([str(repo / "pygrid_tpu")])
+        g = runner.graph()
+        # the engine spawns its device loop with daemon=True — a wedged
+        # device call must not block interpreter exit
+        assert "daemon" in g.domains_of(
+            ("pygrid_tpu/serving/engine.py", "GenerationEngine._loop")
+        )
+        assert "daemon" in g.domains_of(
+            ("pygrid_tpu/telemetry/recorder.py", "PeriodicSnapshotter._run")
+        )
+        # the cycle manager's fold path runs on the executor pool
+        # (run_task_once / the WS dispatch executor), never the loop
+        assert "loop" not in g.domains_of(
+            (
+                "pygrid_tpu/federated/cycle_manager.py",
+                "CycleManager._average_plan_diffs",
+            )
+        )
+
+
+# ── the real GL205 fix: envelope serde outside the fold lock ─────────────
+
+
+class _Rows:
+    def __init__(self):
+        self.modified = []
+
+    def modify(self, where, values):
+        self.modified.append((where, values))
+
+
+class _Models:
+    class _M:
+        id = 7
+
+    def get(self, fl_process_id):
+        return self._M()
+
+    def latest_number(self, model_id):
+        return 3
+
+
+class _WC:
+    def __init__(self, id):
+        self.id = id
+        self.assigned_checkpoint = 3
+        self.worker_id = f"w{id}"
+
+
+def test_async_partial_envelope_encodes_outside_the_fold_lock(monkeypatch):
+    """Regression for the GL205 finding gridconc caught (and its fix):
+    the envelope encode must run with ``_accum_lock`` NOT held, while
+    the row write + fold still happen atomically UNDER it (the flush
+    reads unflushed rows and pops the accumulator under the same
+    lock)."""
+    from pygrid_tpu.federated import cycle_manager as cm_mod
+    from pygrid_tpu.federated import partials, tasks
+    from pygrid_tpu.plans.state import serialize_model_params
+    from pygrid_tpu.serde import state_raw_tensors
+
+    cm = cm_mod.CycleManager.__new__(cm_mod.CycleManager)
+    cm._accum_lock = threading.Lock()
+    cm._async_accum = {}
+    cm._worker_cycles = _Rows()
+    cm.model_manager = _Models()
+
+    class _Cycle:
+        id = 11
+
+    cm.last = lambda pid: _Cycle()
+    monkeypatch.setattr(tasks, "run_task_once", lambda *a, **k: None)
+
+    lock_state = {}
+    real_encode = partials.encode_partial_envelope
+
+    def spying_encode(diff, count, ws):
+        lock_state["encode_held"] = cm._accum_lock.locked()
+        return real_encode(diff, count, ws)
+
+    monkeypatch.setattr(
+        partials, "encode_partial_envelope", spying_encode
+    )
+    real_mark = cm_mod.CycleManager._mark_partial_rows
+
+    def spying_mark(self, wcs, envelope):
+        lock_state["mark_held"] = self._accum_lock.locked()
+        return real_mark(self, wcs, envelope)
+
+    monkeypatch.setattr(
+        cm_mod.CycleManager, "_mark_partial_rows", spying_mark
+    )
+
+    diffs = [np.ones((3,), dtype=np.float32), np.full((2,), 2.0, np.float32)]
+    blob = serialize_model_params(diffs)
+    raws = state_raw_tensors(blob)
+    wcs = [_WC(1), _WC(2)]
+    cm._submit_async_partial(
+        pid=5, wcs=wcs, raws=raws, diff=blob, count=2, ws=2.0,
+        cfg={"staleness_power": 0.5},
+    )
+
+    # the GL205 contract: heavy serde outside, atomic step inside
+    assert lock_state["encode_held"] is False
+    assert lock_state["mark_held"] is True
+    # behavior preserved: both rows marked, fold landed count-weighted
+    assert len(cm._worker_cycles.modified) == 2
+    acc = cm._async_accum[5]
+    assert acc.count == 2
+    # the partial's tensors are a subtree SUM over weight_sum=2.0
+    mean = acc.mean()
+    np.testing.assert_allclose(mean[0], np.full((3,), 0.5))
+    np.testing.assert_allclose(mean[1], np.full((2,), 1.0))

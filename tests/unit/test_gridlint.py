@@ -17,6 +17,7 @@ import pytest
 from pygrid_tpu.analysis import run_checks
 from pygrid_tpu.analysis.checkers import (
     AsyncHygieneChecker,
+    ConcurrencyGraphChecker,
     ContractDriftChecker,
     LockDisciplineChecker,
     PallasBoundsChecker,
@@ -1336,3 +1337,582 @@ class TestCLI:
         out = capsys.readouterr().out
         for code in ("GL101", "GL201", "GL301", "GL401"):
             assert code in out
+
+
+# ── GL2 whole-program concurrency (GL204/GL205/GL206) ────────────────────
+
+
+class TestGL204:
+    def test_cross_class_cycle_through_call_graph_fires(self, tmp_path):
+        """Manager holds its lock into Bus.record (edge M→B); Bus holds
+        its lock into Manager.poke (edge B→M) — a cycle NEITHER class
+        sees alone, only the call graph does."""
+        res = _lint(tmp_path, """
+            import threading
+
+            class Bus:
+                def __init__(self, mgr: "Manager"):
+                    self._lock = threading.Lock()
+                    self._mgr = mgr
+
+                def record(self):
+                    with self._lock:
+                        self._mgr.poke()
+
+            class Manager:
+                def __init__(self, bus: Bus):
+                    self._lock = threading.Lock()
+                    self._bus = bus
+
+                def submit(self):
+                    with self._lock:
+                        self._bus.record()
+
+                def poke(self):
+                    with self._lock:
+                        pass
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL204"]
+        assert "lock-order cycle" in res.failures[0].message
+
+    def test_cross_module_cycle_fires(self, tmp_path):
+        res = _lint(tmp_path, None, ConcurrencyGraphChecker, files={
+            "pkg/__init__.py": "",
+            "pkg/bus.py": """
+                import threading
+                from pkg.mgr import poke_manager
+
+                class Bus:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def record(self):
+                        with self._lock:
+                            poke_manager()
+            """,
+            "pkg/mgr.py": """
+                import threading
+                from pkg import bus as bus_mod
+
+                _lock = threading.Lock()
+
+                def poke_manager():
+                    with _lock:
+                        pass
+
+                def submit(b):
+                    with _lock:
+                        bus_mod.BUS.record()
+            """,
+        })
+        # BUS singleton lives in bus.py for the var-typed resolution
+        (tmp_path / "pkg" / "bus.py").write_text(
+            (tmp_path / "pkg" / "bus.py").read_text()
+            + "\n\nBUS = Bus()\n"
+        )
+        res = _lint(tmp_path, None, ConcurrencyGraphChecker, files={})
+        assert _codes(res) == ["GL204"]
+
+    def test_consistent_order_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Bus:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def record(self):
+                    with self._lock:
+                        pass
+
+            class Manager:
+                def __init__(self, bus: Bus):
+                    self._lock = threading.Lock()
+                    self._bus = bus
+
+                def submit(self):
+                    with self._lock:
+                        self._bus.record()
+
+                def close(self):
+                    with self._lock:
+                        self._bus.record()
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+    def test_one_way_bus_edges_from_many_holders_are_quiet(self, tmp_path):
+        """Every class calling bus.record under its own lock is the
+        repo's normal telemetry shape — edges everywhere, no cycle."""
+        res = _lint(tmp_path, """
+            import threading
+
+            class Bus:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def record(self):
+                    with self._lock:
+                        pass
+
+            class A:
+                def __init__(self, bus: Bus):
+                    self._lock = threading.Lock()
+                    self._bus = bus
+
+                def work(self):
+                    with self._lock:
+                        self._bus.record()
+
+            class B:
+                def __init__(self, bus: Bus):
+                    self._lock = threading.Lock()
+                    self._bus = bus
+
+                def work(self):
+                    with self._lock:
+                        self._bus.record()
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+    def test_single_class_direct_cycle_stays_GL201(self, tmp_path):
+        """An intra-class inverse-nesting cycle is GL201's finding; the
+        whole-program pass must not report it twice."""
+        src = """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def fwd(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def rev(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """
+        res = _lint(tmp_path, src, ConcurrencyGraphChecker)
+        assert res.failures == []
+        res = _lint(tmp_path, src, LockDisciplineChecker)
+        assert _codes(res) == ["GL201"]
+
+    def test_caller_held_sentinel_fabricates_no_edges(self, tmp_path):
+        """*_locked methods scan with the sentinel held — it must count
+        for GL205 but never create GL204 ordering edges."""
+        res = _lint(tmp_path, """
+            import threading
+
+            class Fold:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other = threading.Lock()
+
+                def merge_locked(self):
+                    with self._other:
+                        pass
+
+                def rev(self):
+                    with self._other:
+                        with self._lock:
+                            pass
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+
+class TestGL205:
+    def test_blocking_call_under_lock_fires(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+            import time
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    with self._lock:
+                        time.sleep(1)
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL205"]
+        assert "Worker._lock" in res.failures[0].message
+
+    def test_heavy_serde_one_hop_down_fires_at_the_heavy_line(
+        self, tmp_path
+    ):
+        res = _lint(tmp_path, """
+            import threading
+
+            def pack(blob):
+                return serialize(blob)
+
+            class Manager:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def store(self, blob):
+                    with self._lock:
+                        return pack(blob)
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL205"]
+        f = res.failures[0]
+        assert "serialize" in f.message
+        assert "through the call graph" in f.message
+
+    def test_cross_module_hold_reaches_foreign_blocking_line(
+        self, tmp_path
+    ):
+        res = _lint(tmp_path, None, ConcurrencyGraphChecker, files={
+            "pkg/__init__.py": "",
+            "pkg/codec.py": """
+                def heavy(blob):
+                    return deserialize(blob)
+            """,
+            "pkg/mgr.py": """
+                import threading
+                from pkg.codec import heavy
+
+                class Manager:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def load(self, blob):
+                        with self._lock:
+                            return heavy(blob)
+            """,
+        })
+        assert _codes(res) == ["GL205"]
+        assert res.failures[0].path == "pkg/codec.py"
+        assert "Manager._lock" in res.failures[0].message
+
+    def test_event_loop_domain_weights_the_message(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Handler:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def handle(self, msg):
+                    with self._lock:
+                        return deserialize(msg)
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL205"]
+        assert "EVENT-LOOP STALL" in res.failures[0].message
+
+    def test_caller_holds_lock_convention_counts_as_held(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+            import time
+
+            class Fold:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def drain_locked(self):
+                    time.sleep(0.5)
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL205"]
+        assert "caller-held" in res.failures[0].message
+
+    def test_blocking_outside_the_lock_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+            import time
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    with self._lock:
+                        n = 1
+                    time.sleep(n)
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+    def test_condition_wait_under_lock_is_quiet(self, tmp_path):
+        """Condition.wait RELEASES the lock — the whole point; it must
+        not read as blocking-under-lock."""
+        res = _lint(tmp_path, """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._work = threading.Condition(self._lock)
+
+                def loop(self):
+                    with self._work:
+                        self._work.wait()
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+    def test_two_holders_of_one_heavy_line_report_once(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            def pack(blob):
+                return serialize(blob)
+
+            class Manager:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other = threading.Lock()
+
+                def store(self, blob):
+                    with self._lock:
+                        return pack(blob)
+
+                def restore(self, blob):
+                    with self._other:
+                        return pack(blob)
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL205"]
+
+
+class TestGL206:
+    def test_loop_and_thread_writers_with_no_lock_fire(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=self._run)
+                    self._count = 0
+
+                def _run(self):
+                    self._count += 1
+
+                async def handle(self):
+                    self._count = 0
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL206"]
+        msg = res.failures[0].message
+        assert "Stats._count" in msg and "loop" in msg and "thread" in msg
+
+    def test_executor_and_loop_writers_fire(self, tmp_path):
+        res = _lint(tmp_path, """
+            import asyncio
+
+            class Cache:
+                def __init__(self):
+                    self._entries = {}
+
+                def _refresh(self):
+                    self._entries = {}
+
+                async def serve(self, loop, key):
+                    self._entries[key] = 1
+                    await loop.run_in_executor(None, self._refresh)
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL206"]
+
+    def test_common_lock_across_domains_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=self._run)
+                    self._count = 0
+
+                def _run(self):
+                    with self._lock:
+                        self._count += 1
+
+                async def handle(self):
+                    with self._lock:
+                        self._count = 0
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+    def test_single_domain_writers_are_quiet(self, tmp_path):
+        """Two daemon-thread writers are one inferred domain — GL202's
+        per-class analysis owns intra-domain races."""
+        res = _lint(tmp_path, """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._n = 0
+                    self._a = threading.Thread(target=self._grow, daemon=True)
+                    self._b = threading.Thread(target=self._grow, daemon=True)
+
+                def _grow(self):
+                    self._n += 1
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+    def test_unreached_methods_fabricate_no_races(self, tmp_path):
+        res = _lint(tmp_path, """
+            class Plain:
+                def __init__(self):
+                    self._x = 0
+
+                def a(self):
+                    self._x = 1
+
+                def b(self):
+                    self._x = 2
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+    def test_init_writes_do_not_count(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Snapshotter:
+                def __init__(self):
+                    self._last = None
+                    self._thread = threading.Thread(
+                        target=self._run, daemon=True
+                    )
+
+                def _run(self):
+                    self._last = {}
+        """, ConcurrencyGraphChecker)
+        assert res.failures == []
+
+    def test_disjoint_locks_across_domains_fire(self, tmp_path):
+        res = _lint(tmp_path, """
+            import threading
+
+            class Split:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._state = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._a:
+                        self._state += 1
+
+                async def handle(self):
+                    with self._b:
+                        self._state = 0
+        """, ConcurrencyGraphChecker)
+        assert _codes(res) == ["GL206"]
+        assert "no common lock" in res.failures[0].message
+
+
+class TestGL304NestedDefHop:
+    def test_nested_def_called_directly_fires(self, tmp_path):
+        """ROADMAP backlog: a sync helper defined INSIDE the async body
+        and also called there runs ON the loop — the executor-fodder
+        exemption must not cover it."""
+        res = _lint(tmp_path, """
+            import time
+
+            async def handler():
+                def helper():
+                    time.sleep(1)
+                helper()
+        """, AsyncHygieneChecker)
+        assert _codes(res) == ["GL304"]
+        assert "helper" in res.failures[0].message
+
+    def test_nested_def_only_referenced_stays_exempt(self, tmp_path):
+        res = _lint(tmp_path, """
+            import asyncio
+            import time
+
+            async def handler():
+                def helper():
+                    time.sleep(1)
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, helper)
+        """, AsyncHygieneChecker)
+        assert res.failures == []
+
+    def test_nested_def_shadows_module_helper(self, tmp_path):
+        """The direct call resolves to the NESTED def (python scoping),
+        so the finding lands on its body, once."""
+        res = _lint(tmp_path, """
+            import time
+
+            def helper():
+                pass
+
+            async def handler():
+                def helper():
+                    time.sleep(1)
+                helper()
+        """, AsyncHygieneChecker)
+        assert _codes(res) == ["GL304"]
+        assert res.failures[0].line == 9
+
+
+class TestCLIChangedAndGithub:
+    def test_github_format_emits_annotations(self, tmp_path, capsys):
+        from pygrid_tpu.analysis.cli import main
+
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        bad = tmp_path / "pkg" / "mod.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(textwrap.dedent(_GL2_RACY))
+        rc = main([str(tmp_path), "--no-baseline", "--format", "github"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "::warning file=pkg/mod.py,line=" in out
+        assert "title=gridlint GL202" in out
+
+    def test_changed_mode_analyzes_changed_files_and_dependents(
+        self, tmp_path, capsys
+    ):
+        import subprocess
+
+        from pygrid_tpu.analysis.cli import main
+
+        def git(*args):
+            subprocess.run(
+                ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                 *args],
+                cwd=tmp_path, check=True, capture_output=True,
+            )
+
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        bad_async = textwrap.dedent("""
+            async def handler(msg):
+                return deserialize(msg)
+        """)
+        (pkg / "dep.py").write_text("from pkg.base import x\n" + bad_async)
+        (pkg / "base.py").write_text("x = 1\n" + bad_async)
+        (pkg / "unrelated.py").write_text(bad_async)
+        git("init", "-q")
+        git("add", ".")
+        git("commit", "-qm", "seed")
+        # nothing changed → clean exit, no analysis
+        rc = main([str(tmp_path), "--changed", "--no-baseline"])
+        assert rc == 0
+        assert "no python changes" in capsys.readouterr().out
+        # touch base.py: base AND its importer dep must be analyzed,
+        # unrelated.py must not
+        (pkg / "base.py").write_text("x = 2\n" + bad_async)
+        rc = main([str(tmp_path), "--changed", "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "pkg/base.py" in out and "pkg/dep.py" in out
+        assert "unrelated.py" not in out
+        git("add", ".")
+        git("commit", "-qm", "second")
+        # touch dep.py (the importer): its forward dependency base.py
+        # must ALSO be analyzed — without it the graph cannot resolve
+        # calls into base and cross-module findings sited there vanish
+        (pkg / "dep.py").write_text(
+            "from pkg.base import x  # touched\n" + bad_async
+        )
+        rc = main([str(tmp_path), "--changed", "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "pkg/dep.py" in out and "pkg/base.py" in out
+        assert "unrelated.py" not in out

@@ -18,6 +18,9 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 
 
 def test_gridlint_suite_is_clean_and_fast():
+    from pygrid_tpu.analysis.graph import ProgramGraph
+
+    builds_before = ProgramGraph.builds
     t0 = time.perf_counter()
     result = run_checks([str(REPO_ROOT / "pygrid_tpu")])
     elapsed = time.perf_counter() - t0
@@ -29,6 +32,10 @@ def test_gridlint_suite_is_clean_and_fast():
     # stale allowances mask future regressions — shrink baseline.json
     assert result.stale_baseline == [], "\n".join(result.stale_baseline)
     assert result.files_checked > 100  # the walk actually saw the tree
+    # the whole-program pass (symbol table + call graph + domains) must
+    # be built ONCE and shared by every checker — per-checker rebuilds
+    # are what would blow the wall-clock budget as checkers multiply
+    assert ProgramGraph.builds - builds_before == 1
     assert elapsed < 10.0, f"gridlint took {elapsed:.1f}s (budget 10s)"
 
 
