@@ -33,11 +33,21 @@ Checkers (see docs/ANALYSIS.md for the full rule catalogue):
   (``time.sleep``, sync sockets/requests, ``Future.result()``,
   unbounded ``queue.get()``, megabyte serde) on the event loop inside
   ``async def`` handlers.
-- **GL4 contract drift** (GL401/GL402/GL403/GL404) — bus metric
+- **GL4 contract drift** (GL401/GL402/GL403/GL405/GL406) — bus metric
   families vs docs/OBSERVABILITY.md and the exporter HELP registry;
   wire tag bytes / subprotocol strings vs docs/WIRE.md (and their
-  uniqueness); bare ``ValueError``/``KeyError``/``TypeError`` raises in
-  WS/HTTP handler modules that must raise typed ``PyGridError``s.
+  uniqueness); registered routes and dispatched WS events vs their
+  docs. (GL404's typed-error heuristic is superseded by GL604.)
+- **GL5 Pallas bounds** (GL501/GL502) — statically resolvable
+  ``pallas_call`` tile/shape divisibility and index_map/grid arity.
+- **GL6 dataflow & taint** (GL601/GL602/GL603/GL604, gridtaint —
+  ``analysis/flow.py`` over the same whole-program graph) —
+  interprocedural taint from privacy sources (worker payloads,
+  ``request.json``, credentials, checkpoint bytes) into observability
+  and egress sinks with sanitizer (redact/len/hash) recognition and
+  full witness chains; resource acquire/release pairing on every
+  explicit path; untyped-exception escape from protocol-boundary
+  handlers through the whole call graph.
 
 Per-line suppression: append ``# gridlint: disable=GL202`` (or a
 comma-separated list, or ``all``) to any line of the offending

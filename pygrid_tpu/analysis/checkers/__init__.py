@@ -11,6 +11,7 @@ from pygrid_tpu.analysis.checkers.gl2_locks import LockDisciplineChecker
 from pygrid_tpu.analysis.checkers.gl3_async import AsyncHygieneChecker
 from pygrid_tpu.analysis.checkers.gl4_contracts import ContractDriftChecker
 from pygrid_tpu.analysis.checkers.gl5_pallas import PallasBoundsChecker
+from pygrid_tpu.analysis.checkers.gl6_flow import DataFlowChecker
 
 #: two classes share the GL2 family: the per-class lock rules
 #: (GL201–203) and the whole-program concurrency pass (GL204–206) —
@@ -22,6 +23,7 @@ ALL_CHECKERS = (
     AsyncHygieneChecker,
     ContractDriftChecker,
     PallasBoundsChecker,
+    DataFlowChecker,
 )
 
 __all__ = [
@@ -29,6 +31,7 @@ __all__ = [
     "AsyncHygieneChecker",
     "ConcurrencyGraphChecker",
     "ContractDriftChecker",
+    "DataFlowChecker",
     "LockDisciplineChecker",
     "PallasBoundsChecker",
     "TraceSafetyChecker",

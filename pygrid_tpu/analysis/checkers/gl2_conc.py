@@ -93,7 +93,8 @@ class ConcurrencyGraphChecker(Checker):
             return mods.get(rel)
 
         def _note_blocking(
-            fn: FunctionNode, site, held: frozenset, root: FunctionNode
+            fn: FunctionNode, site, held: frozenset, root: FunctionNode,
+            chain: tuple = (),
         ) -> None:
             mod = _mod(fn.rel_path)
             if mod is None or not held:
@@ -132,6 +133,11 @@ class ConcurrencyGraphChecker(Checker):
                     f"{site.msg} while holding {', '.join(locks)}"
                     f"{via} — {weight}; move the heavy work outside "
                     "the lock or hand it to an executor",
+                    witness=chain
+                    + (
+                        f"blocking call in {fn.pretty} at "
+                        f"{fn.rel_path}:{site.node.lineno}",
+                    ),
                 )
             )
 
@@ -155,19 +161,33 @@ class ConcurrencyGraphChecker(Checker):
                 _note_edges(fn, acq, acq.held_before, "direct")
             for site in fn.blocking:
                 if site.held:
-                    _note_blocking(fn, site, site.held, fn)
+                    _note_blocking(
+                        fn, site, site.held, fn,
+                        chain=(
+                            "lock held in "
+                            f"{fn.pretty} ({fn.rel_path})",
+                        ),
+                    )
 
-        # call-propagated: BFS carrying (callee, held, root holder)
+        # call-propagated: BFS carrying (callee, held, root holder) plus
+        # the witness call chain --explain renders
         seen: set[tuple] = set()
-        frontier: list[tuple[tuple, frozenset, FunctionNode]] = []
+        frontier: list[tuple[tuple, frozenset, FunctionNode, tuple]] = []
         for fn in graph.functions.values():
             for call in fn.calls:
                 if not call.held:
                     continue
+                locks = sorted(
+                    pretty_lock(l) for l in _concrete(call.held)
+                ) or ["<caller-held lock>"]
+                step = (
+                    f"{fn.pretty} holds {', '.join(locks)} and calls "
+                    f"into it at {fn.rel_path}:{call.node.lineno}"
+                )
                 for target in call.targets:
-                    frontier.append((target, call.held, fn))
+                    frontier.append((target, call.held, fn, (step,)))
         while frontier and len(seen) < _MAX_VISITS:
-            key, held, root = frontier.pop()
+            key, held, root, chain = frontier.pop()
             state = (key, held)
             if state in seen:
                 continue
@@ -180,11 +200,20 @@ class ConcurrencyGraphChecker(Checker):
                     fn, acq, held, "call",
                 )
             for site in fn.blocking:
-                _note_blocking(fn, site, held | site.held, root)
+                _note_blocking(fn, site, held | site.held, root, chain)
             for call in fn.calls:
                 new_held = held | call.held
+                step = (
+                    f"which calls {call.dotted}() at "
+                    f"{fn.rel_path}:{call.node.lineno}"
+                )
+                next_chain = (
+                    chain + (step,) if len(chain) < 12 else chain
+                )
                 for target in call.targets:
-                    frontier.append((target, frozenset(new_held), root))
+                    frontier.append(
+                        (target, frozenset(new_held), root, next_chain)
+                    )
 
         # cycle detection over the merged edge graph; single-class
         # all-direct cycles belong to GL201
@@ -214,6 +243,15 @@ class ConcurrencyGraphChecker(Checker):
                             pretty = " -> ".join(
                                 pretty_lock(c) for c in cycle
                             )
+                            witness = tuple(
+                                f"{pretty_lock(a)} -> {pretty_lock(b)} "
+                                f"acquired at "
+                                f"{edges[a][b][0].rel_path}:"
+                                f"{edges[a][b][1].lineno} "
+                                f"({edges[a][b][2]} edge)"
+                                for a, b in zip(cycle, cycle[1:])
+                                if b in edges.get(a, {})
+                            )
                             findings.append(
                                 mod.finding(
                                     "GL204",
@@ -222,6 +260,7 @@ class ConcurrencyGraphChecker(Checker):
                                     f"{pretty} (deadlock under "
                                     "contention; edges follow the "
                                     "whole-program call graph)",
+                                    witness=witness,
                                 )
                             )
                 elif color.get(nxt, 0) == 0:

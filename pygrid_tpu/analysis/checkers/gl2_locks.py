@@ -91,14 +91,35 @@ class _MethodScan(ast.NodeVisitor):
         self.held: list[str] = []  # stack of held lock attrs (canonical)
         self.edges: list[tuple[str, str, ast.AST]] = []
         self.self_deadlocks: list[tuple[ast.AST, str, str]] = []
+        #: ``lock = self._lock`` aliases seen so far — ``with lock:``
+        #: then resolves to the canonical attr (scan is source-ordered,
+        #: so the assignment precedes the with that uses it)
+        self.local_locks: dict[str, str] = {}
 
     def _canonical(self, attr: str) -> str:
         return self.info.aliases.get(attr, attr)
 
+    def _with_lock_attr(self, expr: ast.AST) -> str | None:
+        attr = _self_attr(expr)
+        if attr is None and isinstance(expr, ast.Name):
+            attr = self.local_locks.get(expr.id)
+        return attr
+
+    def _discard_aliases(self, target: ast.AST | None) -> None:
+        """ANY binding construct rebinding an aliased name — tuple
+        unpack, for target, with-as — kills the alias: stale aliases
+        guard regions with a lock that is not held."""
+        if target is None:
+            return
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                self.local_locks.pop(node.id, None)
+
     def visit_With(self, node: ast.With) -> None:
         acquired: list[str] = []
         for item in node.items:
-            attr = _self_attr(item.context_expr)
+            attr = self._with_lock_attr(item.context_expr)
+            self._discard_aliases(item.optional_vars)
             if attr is not None and attr in self.info.locks:
                 canon = self._canonical(attr)
                 for held in self.held:
@@ -127,6 +148,21 @@ class _MethodScan(ast.NodeVisitor):
             self.info.guarded_touch.add(attr)
 
     def visit_Assign(self, node: ast.Assign) -> None:
+        # local lock alias: ``lock = self._lock``; rebinding the name
+        # by ANY other construct (plain assign, tuple unpack) DISCARDS
+        # the alias (a stale alias would mark unguarded regions as
+        # guarded)
+        if len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            value_attr = _self_attr(node.value)
+            if value_attr is not None and value_attr in self.info.locks:
+                self.local_locks[node.targets[0].id] = value_attr
+            else:
+                self.local_locks.pop(node.targets[0].id, None)
+        else:
+            for target in node.targets:
+                self._discard_aliases(target)
         for target in node.targets:
             for el in (
                 target.elts if isinstance(target, ast.Tuple) else [target]
@@ -147,7 +183,15 @@ class _MethodScan(ast.NodeVisitor):
             attr = _self_attr(node.target.value)
         if attr is not None:
             self._record_mutation(attr, node)
+        if isinstance(node.target, ast.Name):
+            self._discard_aliases(node.target)
         self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._discard_aliases(node.target)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
 
     def visit_Delete(self, node: ast.Delete) -> None:
         for target in node.targets:

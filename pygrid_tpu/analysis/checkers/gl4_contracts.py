@@ -16,10 +16,9 @@ from them is a silent break for consumers this repo never tests:
   ``WS_SUBPROTOCOL*`` strings must be unique within their group, every
   tag byte documented in ``docs/WIRE.md`` (as ``0xNN``), every
   subprotocol string quoted there verbatim.
-- **GL404** WS event / HTTP route handler modules must raise typed
-  ``PyGridError`` subclasses for validation — a bare
-  ``ValueError``/``KeyError``/``TypeError`` escapes the protocol
-  boundary as an untyped 500/cryptic string.
+- GL404 (typed errors in handler modules) is SUPERSEDED by GL604:
+  the dataflow checker proves untyped raises unreachable from the
+  protocol boundary instead of guessing by module path.
 - **GL405** every HTTP route path registered in ``node/routes.py`` /
   ``network/routes.py`` (``r.add_get("/path", …)`` and friends) must
   appear in README.md or a ``docs/*.md`` file — an endpoint nobody can
@@ -42,19 +41,6 @@ import os
 from typing import Iterable
 
 from pygrid_tpu.analysis.core import Checker, Finding, ModuleContext
-
-#: modules whose functions serve the WS/HTTP protocol boundary (GL404).
-#: fnmatch-style, matched against repo-relative paths.
-_HANDLER_MODULE_PATTERNS = (
-    "*/node/events.py",
-    "*/node/routes.py",
-    "*/node/ws.py",
-    "*/network/routes.py",
-    "*/network/ws.py",
-    "*/users/events.py",
-)
-
-_BARE_ERRORS = {"ValueError", "KeyError", "TypeError"}
 
 #: route-registration modules (GL405); fnmatch vs repo-relative paths
 _ROUTE_MODULE_PATTERNS = ("*/node/routes.py", "*/network/routes.py")
@@ -112,8 +98,6 @@ class ContractDriftChecker(Checker):
         "GL401": "bus metric family missing from docs/OBSERVABILITY.md",
         "GL402": "bus metric family missing from the _FAMILY_HELP registry",
         "GL403": "wire constant duplicated or missing from docs/WIRE.md",
-        "GL404": "bare ValueError/KeyError/TypeError raised in a handler "
-        "module",
         "GL405": "registered HTTP route path missing from README/docs",
         "GL406": "ROUTES WS event key missing from docs/WIRE.md",
     }
@@ -209,32 +193,6 @@ class ContractDriftChecker(Checker):
                     ):
                         self._wire_protocols.append((t.id, value, mod, node))
 
-        # GL404 — handler modules must raise typed errors
-        if any(
-            fnmatch.fnmatch(mod.rel_path, pat)
-            for pat in _HANDLER_MODULE_PATTERNS
-        ):
-            for node in ast.walk(mod.tree):
-                if not isinstance(node, ast.Raise) or node.exc is None:
-                    continue
-                exc = node.exc
-                name = None
-                if isinstance(exc, ast.Call) and isinstance(
-                    exc.func, ast.Name
-                ):
-                    name = exc.func.id
-                elif isinstance(exc, ast.Name):
-                    name = exc.id
-                if name in _BARE_ERRORS:
-                    findings.append(
-                        mod.finding(
-                            "GL404",
-                            node,
-                            f"handler module raises bare '{name}' — raise a "
-                            "typed PyGridError subclass so the protocol "
-                            "boundary answers a typed error",
-                        )
-                    )
         return findings
 
     def _collect_constants(self, mod: ModuleContext) -> None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 
@@ -52,9 +53,23 @@ def _build_parser() -> argparse.ArgumentParser:
         "pre-commit loop",
     )
     parser.add_argument(
-        "--format", choices=("text", "json", "github"), default="text",
+        "--format", choices=("text", "json", "github", "sarif"),
+        default="text",
         help="github: one ::warning file=…,line=…:: annotation per "
-        "finding, for CI inline surfacing",
+        "finding, for CI inline surfacing; sarif: SARIF 2.1.0 with the "
+        "witness chain of each propagated finding as codeFlows",
+    )
+    parser.add_argument(
+        "--output",
+        help="write the formatted report to this file instead of "
+        "stdout (the CI artifact path for --format sarif)",
+    )
+    parser.add_argument(
+        "--explain", metavar="GLNNN",
+        help="print every finding of one rule (failures AND baselined "
+        "allowances) with its witness chain — the call path a "
+        "propagated GL204/GL205 finding rode, the source→sink taint "
+        "path of a GL601/GL602, the escape route of a GL604",
     )
     parser.add_argument(
         "--list-checkers", action="store_true",
@@ -105,11 +120,14 @@ def _git_changed_files(root: str) -> set[str] | None:
     return out
 
 
-def _changed_closure(targets: list[str]) -> list[str] | None | str:
+def _changed_closure(
+    targets: list[str],
+) -> tuple[list[str], set[str]] | str:
     """The ``--changed`` target set: changed files under ``targets``
     plus their transitive reverse-import dependents (a changed callee
-    can flip a caller's cross-module findings). Returns the file list,
-    ``[]`` for "nothing changed", or an error string."""
+    can flip a caller's cross-module findings) plus forward-import
+    context. Returns ``(file list, stale scope rel-paths)`` —
+    ``([], …)`` for "nothing changed" — or an error string."""
     import os
 
     from pygrid_tpu.analysis.core import _infer_root, _iter_py_files
@@ -123,12 +141,124 @@ def _changed_closure(targets: list[str]) -> list[str] | None | str:
     by_rel = {
         os.path.relpath(p, root).replace(os.sep, "/"): p for p in files
     }
-    keep = import_dependents(
+    keep, stale_scope = import_dependents(
         files,
         lambda p: os.path.relpath(p, root).replace(os.sep, "/"),
         set(changed),
     )
-    return [by_rel[rel] for rel in sorted(keep) if rel in by_rel]
+    return (
+        [by_rel[rel] for rel in sorted(keep) if rel in by_rel],
+        stale_scope,
+    )
+
+
+#: parses the ``… at path:line`` location a witness step carries
+#: (possibly mid-step — GL204 edges end with their provenance), so
+#: each SARIF codeFlow location points at real code
+_STEP_LOC = re.compile(r" at ([\w./-]+\.py):(\d+)")
+
+
+def _sarif_location(path: str, line: int, col: int = 0) -> dict:
+    return {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path},
+            "region": {
+                "startLine": max(1, line),
+                "startColumn": max(1, col + 1),
+            },
+        }
+    }
+
+
+def _sarif_report(result) -> dict:
+    """SARIF 2.1.0: one result per finding; witness chains become
+    codeFlows (threadFlow locations, source first) so SARIF viewers
+    render the whole propagation path inline."""
+    rules: dict[str, dict] = {}
+    for cls in ALL_CHECKERS:
+        for code, what in cls.codes.items():
+            rules[code] = {
+                "id": code,
+                "shortDescription": {"text": what},
+                "helpUri": "docs/ANALYSIS.md",
+            }
+    results = []
+    for f in result.failures:
+        entry = {
+            "ruleId": f.code,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [_sarif_location(f.path, f.line, f.col)],
+        }
+        if f.witness:
+            flow_locs = []
+            for step in f.witness:
+                m = _STEP_LOC.search(step)
+                loc = (
+                    _sarif_location(m.group(1), int(m.group(2)))
+                    if m
+                    else _sarif_location(f.path, f.line, f.col)
+                )
+                flow_locs.append(
+                    {"location": {**loc, "message": {"text": step}}}
+                )
+            entry["codeFlows"] = [
+                {"threadFlows": [{"locations": flow_locs}]}
+            ]
+        results.append(entry)
+    for err in result.parse_errors:
+        results.append(
+            {
+                "ruleId": "GL000",
+                "level": "error",
+                "message": {"text": f"parse error: {err}"},
+            }
+        )
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "gridlint",
+                        "informationUri": "docs/ANALYSIS.md",
+                        "rules": sorted(
+                            rules.values(), key=lambda r: r["id"]
+                        ),
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def _explain(result, code: str) -> str:
+    """Human rendering of every finding of ``code`` with its witness
+    chain — baselined allowances included (explaining a deliberate
+    allowance is the command's main use)."""
+    lines: list[str] = []
+    shown = 0
+    for f, status in [(f, "FAIL") for f in result.failures] + [
+        (f, "baselined") for f in result.baselined
+    ]:
+        if f.code != code.upper():
+            continue
+        shown += 1
+        lines.append(f"[{status}] {f.render()}")
+        if f.witness:
+            for i, step in enumerate(f.witness):
+                lines.append(f"    {'└─' if i else '┌─'} {step}")
+        else:
+            lines.append("    (no recorded witness chain — the finding "
+                         "is sited where it fires)")
+    if not shown:
+        lines.append(f"no {code.upper()} findings in this run")
+    return "\n".join(lines)
 
 
 def _github_annotations(result) -> list[str]:
@@ -166,16 +296,17 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     targets = list(args.targets)
+    stale_scope: set[str] | None = None
     if args.changed:
         closure = _changed_closure(targets)
         if isinstance(closure, str):
             print(closure, file=sys.stderr)
             return 2
-        if not closure:
+        targets, stale_scope = closure
+        if not targets:
             if not args.quiet:
                 print("gridlint --changed: no python changes")
             return 0
-        targets = closure
 
     checkers = [cls() for cls in ALL_CHECKERS]
     if args.select:
@@ -199,7 +330,8 @@ def main(argv: list[str] | None = None) -> int:
 
     t0 = time.perf_counter()
     result = run_checks(
-        targets, checkers=checkers, baseline_path=baseline_path
+        targets, checkers=checkers, baseline_path=baseline_path,
+        stale_scope=stale_scope,
     )
     elapsed = time.perf_counter() - t0
 
@@ -207,11 +339,31 @@ def main(argv: list[str] | None = None) -> int:
         args.strict_baseline and bool(result.stale_baseline)
     )
 
+    def _emit(text: str) -> None:
+        if args.output:
+            with open(args.output, "w", encoding="utf-8") as fh:
+                fh.write(text if text.endswith("\n") else text + "\n")
+            if not args.quiet:
+                print(f"gridlint: wrote {args.format} to {args.output}")
+        else:
+            print(text)
+
+    if args.explain:
+        _emit(_explain(result, args.explain))
+        return 0  # informational — the gate is the plain run
+
+    if args.format == "sarif":
+        _emit(json.dumps(_sarif_report(result), indent=2))
+        return 1 if failed else 0
+
     if args.format == "github":
-        for line in _github_annotations(result):
-            print(line)
-        for note in result.stale_baseline:
-            print(f"::notice title=gridlint stale baseline::{note}")
+        lines = _github_annotations(result)
+        lines.extend(
+            f"::notice title=gridlint stale baseline::{note}"
+            for note in result.stale_baseline
+        )
+        if lines:
+            _emit("\n".join(lines))
         if not args.quiet:
             print(
                 f"gridlint: {result.files_checked} files, "
@@ -223,7 +375,7 @@ def main(argv: list[str] | None = None) -> int:
         return 1 if failed else 0
 
     if args.format == "json":
-        print(
+        _emit(
             json.dumps(
                 {
                     "ok": not failed,
@@ -240,14 +392,14 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 1 if failed else 0
 
-    for err in result.parse_errors:
-        print(f"PARSE ERROR {err}")
-    for f in result.failures:
-        print(f.render())
-    for f in result.suppressed:
-        print(f"suppressed: {f.render()}")
-    for note in result.stale_baseline:
-        print(f"stale baseline: {note}")
+    lines = [f"PARSE ERROR {err}" for err in result.parse_errors]
+    lines.extend(f.render() for f in result.failures)
+    lines.extend(f"suppressed: {f.render()}" for f in result.suppressed)
+    lines.extend(
+        f"stale baseline: {note}" for note in result.stale_baseline
+    )
+    if lines:
+        _emit("\n".join(lines))
     if not args.quiet:
         print(
             f"gridlint: {result.files_checked} files, "

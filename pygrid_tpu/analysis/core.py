@@ -42,6 +42,10 @@ class Finding:
     #: last physical line of the offending statement — a suppression
     #: directive anywhere in [line, end_line] covers the finding
     end_line: int = 0
+    #: the witness chain for propagated findings (GL204/GL205 call
+    #: chains, GL601/GL602 taint paths, GL604 escape routes) — rendered
+    #: by ``--explain`` and as SARIF codeFlows
+    witness: tuple = ()
 
     @property
     def checker(self) -> str:
@@ -74,7 +78,8 @@ class ModuleContext:
         self.runner = runner
 
     def finding(
-        self, code: str, node: ast.AST, message: str
+        self, code: str, node: ast.AST, message: str,
+        witness: tuple = (),
     ) -> Finding:
         line = getattr(node, "lineno", 1)
         return Finding(
@@ -84,6 +89,7 @@ class ModuleContext:
             col=getattr(node, "col_offset", 0),
             message=message,
             end_line=getattr(node, "end_lineno", None) or line,
+            witness=tuple(witness),
         )
 
     def suppressed_codes(self, line: int, end_line: int | None) -> set[str]:
@@ -256,7 +262,10 @@ class Runner:
         return any(fnmatch.fnmatch(rel_path, pat) for pat in self.exclude)
 
     def run(
-        self, targets: Sequence[str], baseline: Baseline | None = None
+        self,
+        targets: Sequence[str],
+        baseline: Baseline | None = None,
+        stale_scope: set[str] | None = None,
     ) -> RunResult:
         result = RunResult()
         raw_findings: list[tuple[ModuleContext | None, Finding]] = []
@@ -325,9 +334,15 @@ class Runner:
         # an absent entry is only STALE when this run could have produced
         # it: the entry's checker ran and its file was scanned — else a
         # --select or subset-target run would fail clean trees and tell
-        # the operator to delete allowances that are still live
+        # the operator to delete allowances that are still live. A
+        # --changed run narrows further via ``stale_scope``: files that
+        # rode along only as forward-import CONTEXT cannot reproduce
+        # findings whose producer (a taint source, a lock holder) lives
+        # outside the subset
         ran_families = {c.name for c in self.checkers}
         scanned = set(mods_by_rel)
+        if stale_scope is not None:
+            scanned &= stale_scope
         for (path, code), entry in baseline.entries.items():
             if (
                 (path, code) not in seen_keys
@@ -354,9 +369,12 @@ def run_checks(
     baseline_path: str | Path | None = None,
     root: str | Path | None = None,
     exclude: Sequence[str] = (),
+    stale_scope: set[str] | None = None,
 ) -> RunResult:
     """One-call API: run ``checkers`` (default: all) over ``targets``
-    with the committed baseline (pass ``baseline_path=""`` for none)."""
+    with the committed baseline (pass ``baseline_path=""`` for none).
+    ``stale_scope`` (rel paths) narrows which files' baseline entries
+    may be reported stale — ``--changed`` passes the non-context subset."""
     from pygrid_tpu.analysis.checkers import ALL_CHECKERS
 
     if checkers is None:
@@ -369,7 +387,7 @@ def run_checks(
     if root is None:
         root = _infer_root(targets)
     runner = Runner(checkers, root=root, exclude=exclude)
-    return runner.run(targets, baseline)
+    return runner.run(targets, baseline, stale_scope=stale_scope)
 
 
 def _infer_root(targets: Sequence[str]) -> str:

@@ -385,6 +385,13 @@ class ClassSymbol:
         self.node = node
         self.locks: dict[str, str] = {}  # attr -> ctor name
         self.aliases: dict[str, str] = {}  # attr -> attr it wraps
+        #: base-class dotted names as written; resolved to class keys in
+        #: the graph's cross-module pass — ``self.method()`` and lock/
+        #: collaborator lookups walk the MRO these induce
+        self.base_exprs: list[str] = [
+            d for d in (dotted(b) for b in node.bases) if d is not None
+        ]
+        self.bases: list[tuple] = []  # resolved (rel_path, class) keys
         #: attr -> unresolved type expression (a dotted ctor string, or
         #: ("param", name) for annotated __init__ params) — resolved to
         #: class keys in the graph's cross-module pass
@@ -548,17 +555,34 @@ class _BodyScan(ast.NodeVisitor):
                 (fn.rel_path, cls.name if cls else "<module>", SENTINEL_HELD)
             )
         self.local_types: dict[str, tuple] = {}
+        #: local lock aliases — ``lock = self._lock`` makes ``with
+        #: lock:`` resolve to the CANONICAL lock identity
+        self.local_locks: dict[str, tuple[LockId, bool]] = {}
         self._collect_local_types(fn.node)
 
     def _collect_local_types(self, fn_node: ast.AST) -> None:
-        """``x = ClassName(...)`` in this body → x's class key (one
-        pass up front: with-statements may precede the scan order)."""
+        """``x = ClassName(...)`` → x's class key; ``x = self._lock`` →
+        x aliases that lock (one pass up front: with-statements may
+        precede the scan order). A name EVER bound to anything that is
+        not one single lock is poisoned — the flow-insensitive alias
+        must err unaliased, never guard a region with a stale lock."""
+        bindings: dict[str, tuple[LockId, bool]] = {}
+        poisoned: set[str] = set()
+
+        def _poison_target(target: ast.AST | None) -> None:
+            if target is None:
+                return
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Name):
+                    poisoned.add(sub.id)
+
         for node in _walk_skipping_defs(fn_node):
             if isinstance(node, ast.Assign) and len(node.targets) == 1:
                 t = node.targets[0]
-                if isinstance(t, ast.Name) and isinstance(
-                    node.value, ast.Call
-                ):
+                if not isinstance(t, ast.Name):
+                    _poison_target(t)  # tuple unpack rebinds every elt
+                    continue
+                if isinstance(node.value, ast.Call):
                     d = dotted(node.value.func)
                     if d is not None:
                         key = self.graph.resolve_class(
@@ -566,6 +590,32 @@ class _BodyScan(ast.NodeVisitor):
                         )
                         if key is not None:
                             self.local_types[t.id] = key
+                    poisoned.add(t.id)
+                    continue
+                resolved = self._lock_of(node.value)
+                if resolved is None:
+                    poisoned.add(t.id)
+                elif t.id in bindings and bindings[t.id] != resolved:
+                    poisoned.add(t.id)
+                else:
+                    bindings[t.id] = resolved
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:  # multi-target chains rebind
+                    _poison_target(t)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                _poison_target(node.target)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    _poison_target(item.optional_vars)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                _poison_target(node.target)
+            elif isinstance(node, ast.NamedExpr):
+                _poison_target(node.target)
+            elif isinstance(node, ast.comprehension):
+                _poison_target(node.target)
+        for name, resolved in bindings.items():
+            if name not in poisoned:
+                self.local_locks[name] = resolved
 
     # nested bodies are their own FunctionNodes
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -586,21 +636,15 @@ class _BodyScan(ast.NodeVisitor):
         ):
             recv, attr = expr.value.id, expr.attr
             if recv in ("self", "cls") and self.cls is not None:
-                if attr in self.cls.locks:
-                    return (
-                        self.cls.lock_id(attr),
-                        self.cls.locks[attr] in REENTRANT_CTORS,
-                    )
-                return None
+                # through the MRO: a base-class lock acquired from a
+                # subclass method canonicalizes to the defining class
+                return self.graph.class_lock(
+                    (self.cls.rel_path, self.cls.name), attr
+                )
             # x.lock where x has a known local type
             key = self.local_types.get(recv)
             if key is not None:
-                target = self.graph.classes.get(key)
-                if target is not None and attr in target.locks:
-                    return (
-                        target.lock_id(attr),
-                        target.locks[attr] in REENTRANT_CTORS,
-                    )
+                return self.graph.class_lock(key, attr)
             # mod._lock through an import binding
             mod = self.syms.imports.aliases.get(recv)
             rel = self.graph.dotted_to_rel.get(mod or "")
@@ -621,16 +665,18 @@ class _BodyScan(ast.NodeVisitor):
                 and inner.value.id in ("self", "cls")
                 and self.cls is not None
             ):
-                key = self.cls.attr_types.get(inner.attr)
-                target = self.graph.classes.get(key) if key else None
-                if target is not None and expr.attr in target.locks:
-                    return (
-                        target.lock_id(expr.attr),
-                        target.locks[expr.attr] in REENTRANT_CTORS,
-                    )
+                key = self.graph.class_attr_type(
+                    (self.cls.rel_path, self.cls.name), inner.attr
+                )
+                if key is not None:
+                    return self.graph.class_lock(key, expr.attr)
             return None
+        # local alias (``lock = self._lock; with lock:``) first, then
         # bare module-level lock
         if isinstance(expr, ast.Name):
+            alias = self.local_locks.get(expr.id)
+            if alias is not None:
+                return alias
             if expr.id in self.syms.module_locks:
                 return (
                     (self.syms.rel_path, "<module>", expr.id),
@@ -884,6 +930,70 @@ class ProgramGraph:
                 return None
         return None
 
+    # ── inheritance: method/lock/collaborator lookup through bases ──────
+
+    def mro(self, cls_key: tuple) -> list[tuple]:
+        """Linearized base-class order (BFS, cycle-safe) starting at
+        ``cls_key`` — only classes the run actually parsed appear, so
+        stdlib/third-party bases simply end the walk."""
+        out: list[tuple] = []
+        seen: set[tuple] = set()
+        frontier = [cls_key]
+        while frontier:
+            key = frontier.pop(0)
+            if key in seen or key not in self.classes:
+                continue
+            seen.add(key)
+            out.append(key)
+            frontier.extend(self.classes[key].bases)
+        return out
+
+    def resolve_method(self, cls_key: tuple, method: str) -> tuple | None:
+        """``self.method()`` resolution THROUGH base classes: the first
+        MRO class defining ``method`` wins — so a subclass handler
+        inherits the base implementation's lock/domain/flow facts."""
+        for key in self.mro(cls_key):
+            qual = f"{key[1]}.{method}"
+            target = self.modules.get(key[0])
+            if target is not None and qual in target.index.defs:
+                return (key[0], qual)
+        return None
+
+    def class_lock(
+        self, cls_key: tuple, attr: str
+    ) -> tuple[LockId, bool] | None:
+        """A lock attr through the MRO: ``(LockId, reentrant)``. The
+        canonical identity is the DEFINING class, so a base-class lock
+        acquired from a subclass method is ONE lock, not two."""
+        for key in self.mro(cls_key):
+            cls = self.classes[key]
+            if attr in cls.locks:
+                return (
+                    cls.lock_id(attr),
+                    cls.locks[attr] in REENTRANT_CTORS,
+                )
+        return None
+
+    def class_attr_type(self, cls_key: tuple, attr: str) -> tuple | None:
+        """A typed ``self._x`` collaborator through the MRO."""
+        for key in self.mro(cls_key):
+            t = self.classes[key].attr_types.get(attr)
+            if t is not None:
+                return t
+        return None
+
+    def is_subclass_of(self, cls_key: tuple, base_name: str) -> bool:
+        """True when any MRO entry — or any of its UNRESOLVED written
+        bases — is named ``base_name`` (hierarchy-membership test for
+        GL604's typed-error contract)."""
+        for key in self.mro(cls_key):
+            if key[1] == base_name:
+                return True
+            for expr in self.classes[key].base_exprs:
+                if expr.split(".")[-1] == base_name:
+                    return True
+        return False
+
     def _resolve_types(self) -> None:
         for rel, syms in self.modules.items():
             for name, expr in syms.var_exprs.items():
@@ -891,6 +1001,10 @@ class ProgramGraph:
                 if key is not None:
                     syms.var_types[name] = key
             for cls in syms.classes.values():
+                for base in cls.base_exprs:
+                    key = self.resolve_class(rel, base)
+                    if key is not None and key != (rel, cls.name):
+                        cls.bases.append(key)
                 for attr, expr in cls.attr_exprs.items():
                     if isinstance(expr, str):
                         key = self.resolve_class(rel, expr)
@@ -949,47 +1063,33 @@ class ProgramGraph:
         if len(parts) == 1:
             return self._resolve_symbol(rel, dotted_name)
         head, rest = parts[0], parts[1:]
-        # self.m / cls.m (+ self._attr.m through a typed collaborator)
+        # self.m / cls.m (+ self._attr.m through a typed collaborator);
+        # methods resolve through the MRO so subclass handlers land on
+        # inherited implementations
         if head in ("self", "cls"):
             if len(rest) == 1 and class_name is not None:
-                qual = f"{class_name}.{rest[0]}"
-                if qual in syms.index.defs:
-                    return [(rel, qual)]
-                return []
+                hit = self.resolve_method((rel, class_name), rest[0])
+                return [hit] if hit is not None else []
             if len(rest) == 2 and class_name is not None:
-                cls = syms.classes.get(class_name)
-                key = cls.attr_types.get(rest[0]) if cls else None
+                key = self.class_attr_type((rel, class_name), rest[0])
                 if key is not None:
-                    qual = f"{key[1]}.{rest[1]}"
-                    target = self.modules.get(key[0])
-                    if target is not None and qual in target.index.defs:
-                        return [(key[0], qual)]
+                    hit = self.resolve_method(key, rest[1])
+                    return [hit] if hit is not None else []
                 return []
             return []
         # x.m where x is a typed local
         if local_types and head in local_types and len(rest) == 1:
-            key = local_types[head]
-            qual = f"{key[1]}.{rest[0]}"
-            target = self.modules.get(key[0])
-            if target is not None and qual in target.index.defs:
-                return [(key[0], qual)]
-            return []
+            hit = self.resolve_method(local_types[head], rest[0])
+            return [hit] if hit is not None else []
         # X.m where X is a module-level typed singleton
         if head in syms.var_types and len(rest) == 1:
-            key = syms.var_types[head]
-            qual = f"{key[1]}.{rest[0]}"
-            target = self.modules.get(key[0])
-            if target is not None and qual in target.index.defs:
-                return [(key[0], qual)]
-            return []
+            hit = self.resolve_method(syms.var_types[head], rest[0])
+            return [hit] if hit is not None else []
         # Class.m of a local (or imported) class
         cls_key = self.resolve_class(rel, head)
         if cls_key is not None and len(rest) == 1:
-            qual = f"{cls_key[1]}.{rest[0]}"
-            target = self.modules.get(cls_key[0])
-            if target is not None and qual in target.index.defs:
-                return [(cls_key[0], qual)]
-            return []
+            hit = self.resolve_method(cls_key, rest[0])
+            return [hit] if hit is not None else []
         # module path through an import binding
         mod = syms.imports.aliases.get(head)
         if mod is None:
@@ -1006,17 +1106,17 @@ class ProgramGraph:
                 return self._resolve_symbol(target_rel, remainder[0])
             if len(remainder) == 2:
                 first, meth = remainder
-                # Class.m
-                qual = f"{first}.{meth}"
-                if qual in target.index.defs:
-                    return [(target_rel, qual)]
+                # Class.m (through the MRO)
+                if first in target.classes:
+                    hit = self.resolve_method((target_rel, first), meth)
+                    if hit is not None:
+                        return [hit]
                 # singleton.m (BUS.incr spelled from outside)
                 key = target.var_types.get(first)
                 if key is not None:
-                    qual = f"{key[1]}.{meth}"
-                    owner = self.modules.get(key[0])
-                    if owner is not None and qual in owner.index.defs:
-                        return [(key[0], qual)]
+                    hit = self.resolve_method(key, meth)
+                    if hit is not None:
+                        return [hit]
             return []
         return []
 
@@ -1110,7 +1210,7 @@ def import_dependents(
     files: Iterable[str],
     rel_of,
     changed: set[str],
-) -> set[str]:
+) -> tuple[set[str], set[str]]:
     """The ``--changed`` analysis set: the changed files (rel paths),
     everything that imports them transitively (a changed callee can
     flip a caller's findings), AND the transitive forward imports of
@@ -1119,7 +1219,16 @@ def import_dependents(
     GL204/GL205 shape: the blocking line lives where the code blocks,
     not where the lock was taken) would be silently missed. ``rel_of``
     maps an abs path to its repo-relative POSIX path. Files that fail
-    to parse are kept (the full run will report them)."""
+    to parse are kept (the full run will report them).
+
+    Returns ``(analysis set, stale scope)``. The stale scope is the
+    changed + reverse-dependent subset — the files whose OWN findings
+    this run can reproduce (their dependencies all ride along via the
+    forward pass). Files pulled in ONLY as forward dependencies are
+    call-resolution context: their cross-module findings may originate
+    in files outside the set (a GL602 sink whose taint source lives in
+    an unchanged caller), so their baseline allowances must not be
+    marked stale by a subset run."""
     rels: dict[str, str] = {}
     deps: dict[str, set[str]] = {}
     dotted_to_rel: dict[str, str] = {}
@@ -1165,6 +1274,7 @@ def import_dependents(
             if dependent not in out:
                 out.add(dependent)
                 frontier.append(dependent)
+    stale_scope = set(out)
     # forward closure: pull in what the analysis set imports, so calls
     # out of changed/dependent files resolve and their findings land
     frontier = list(out)
@@ -1174,4 +1284,4 @@ def import_dependents(
             if dep not in out:
                 out.add(dep)
                 frontier.append(dep)
-    return out
+    return out, stale_scope

@@ -177,7 +177,7 @@ def http_twin(event_type: str, ctx_key: str):
             if not isinstance(body, dict):
                 # typed, like every protocol-boundary defect: a bare
                 # ValueError here would be indistinguishable from an
-                # internal bug to middleware and tests (gridlint GL404)
+                # internal bug to middleware and tests (gridlint GL604)
                 raise E.PyGridError("JSON object body required")
         except (
             json.JSONDecodeError,

@@ -8,15 +8,38 @@
 #   scripts/gridlint.sh --changed      # git-changed files + their
 #                                      # call-graph dependents (the
 #                                      # fast pre-commit loop)
+#   scripts/gridlint.sh --sarif [out]  # SARIF 2.1.0 report (witness
+#                                      # chains as codeFlows); under
+#                                      # GITHUB_ACTIONS the artifact
+#                                      # name is auto-selected
+#   scripts/gridlint.sh --explain GL205  # witness chains for one rule
 #
 # Under GitHub Actions the findings are emitted as ::warning
 # annotations (one per finding) so CI surfaces them inline on the PR —
 # pass an explicit --format to override.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# --sarif [path]: emit SARIF; auto-name the artifact in CI so upload
+# steps can glob gridlint-*.sarif without coordination
+if [ "${1:-}" = "--sarif" ]; then
+  shift
+  out=""
+  if [ $# -gt 0 ] && [ "${1#-}" = "$1" ]; then
+    out="$1"; shift
+  elif [ "${GITHUB_ACTIONS:-}" = "true" ]; then
+    out="gridlint-${GITHUB_RUN_ID:-local}.sarif"
+  fi
+  if [ -n "$out" ]; then
+    exec python -m pygrid_tpu.analysis --strict-baseline \
+      --format sarif --output "$out" "$@"
+  fi
+  exec python -m pygrid_tpu.analysis --strict-baseline --format sarif "$@"
+fi
+
 if [ "${GITHUB_ACTIONS:-}" = "true" ]; then
   case " $* " in
-    *" --format"*) ;;
+    *" --format"*|*" --explain"*) ;;
     *) set -- --format github "$@" ;;
   esac
 fi

@@ -113,6 +113,251 @@ class TestResolution:
         assert ("pkg/pool.py", "BlockPool.release") in targets
 
 
+class TestLockAliasing:
+    """``lock = self._lock; with lock:`` resolves to the canonical lock
+    identity — the PR-10 gridconc follow-up."""
+
+    def test_local_alias_resolves_in_the_graph(self, tmp_path):
+        g = _graph(tmp_path, {
+            "pkg/a.py": """
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def work(self):
+                        lock = self._lock
+                        with lock:
+                            pass
+            """,
+        })
+        work = g.functions[("pkg/a.py", "Box.work")]
+        assert [a.lock for a in work.acquires] == [
+            ("pkg/a.py", "Box", "_lock")
+        ]
+
+    def test_gl205_fires_through_a_local_alias(self, tmp_path):
+        from pygrid_tpu.analysis.checkers.gl2_conc import (
+            ConcurrencyGraphChecker,
+        )
+        from pygrid_tpu.analysis.core import Runner
+
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        f = tmp_path / "pkg" / "a.py"
+        f.parent.mkdir(parents=True)
+        f.write_text(textwrap.dedent("""
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def work(self, blob):
+                    lock = self._lock
+                    with lock:
+                        serialize(blob)
+
+            def serialize(blob):
+                return blob
+        """))
+        runner = Runner([ConcurrencyGraphChecker()], root=str(tmp_path))
+        res = runner.run([str(tmp_path)])
+        assert [x.code for x in res.failures] == ["GL205"]
+        assert "Box._lock" in res.failures[0].message
+        # the recorded witness chain is what --explain GL205 renders
+        w = " ".join(res.failures[0].witness)
+        assert "Box.work" in w and "blocking call" in w
+
+    def test_gl202_mutation_under_aliased_lock_counts_as_guarded(
+        self, tmp_path
+    ):
+        from pygrid_tpu.analysis.checkers.gl2_locks import (
+            LockDisciplineChecker,
+        )
+        from pygrid_tpu.analysis.core import Runner
+
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        f = tmp_path / "pkg" / "a.py"
+        f.parent.mkdir(parents=True)
+        f.write_text(textwrap.dedent("""
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def locked_incr(self):
+                    lock = self._lock
+                    with lock:
+                        self._n += 1
+
+                def raced_incr(self):
+                    self._n += 1
+        """))
+        runner = Runner([LockDisciplineChecker()], root=str(tmp_path))
+        res = runner.run([str(tmp_path)])
+        # the alias makes locked_incr GUARDED (which is what marks _n
+        # lock-protected at all) — only the genuinely raced write fires
+        assert [x.code for x in res.failures] == ["GL202"]
+        assert res.failures[0].line >= 14
+
+
+    def test_rebound_alias_is_discarded(self, tmp_path):
+        """A name rebound away from the lock must stop counting as the
+        lock — in the per-class scanner (the stale alias would mark the
+        guarded region and so mark the attr lock-protected) AND in the
+        graph's flow-insensitive collector (a name ever bound to
+        anything but one single lock is poisoned)."""
+        from pygrid_tpu.analysis.checkers.gl2_locks import (
+            LockDisciplineChecker,
+        )
+        from pygrid_tpu.analysis.core import Runner
+
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        f = tmp_path / "pkg" / "a.py"
+        f.parent.mkdir(parents=True)
+        f.write_text(textwrap.dedent("""
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def work(self, other):
+                    lock = self._lock
+                    lock = other
+                    with lock:
+                        self._n += 1
+
+                def raw(self):
+                    self._n += 1
+        """))
+        runner = Runner([LockDisciplineChecker()], root=str(tmp_path))
+        res = runner.run([str(tmp_path)])
+        # the rebound alias guards NOTHING, so _n is never observed
+        # under self._lock and stays thread-confined — zero findings
+        # (the stale-alias bug instead made raw() fire)
+        assert [x.code for x in res.failures] == []
+        g = runner.graph()
+        work = g.functions[("pkg/a.py", "Box.work")]
+        assert work.acquires == []  # poisoned in the graph too
+
+    def test_tuple_and_for_rebinds_also_discard_the_alias(self, tmp_path):
+        """Rebinding through tuple unpack or a for target kills the
+        alias too — the stale-alias class is any binding construct,
+        not just plain assignment."""
+        from pygrid_tpu.analysis.checkers.gl2_locks import (
+            LockDisciplineChecker,
+        )
+        from pygrid_tpu.analysis.core import Runner
+
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        f = tmp_path / "pkg" / "a.py"
+        f.parent.mkdir(parents=True)
+        f.write_text(textwrap.dedent("""
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def unpacked(self, pair):
+                    lock = self._lock
+                    lock, other = pair
+                    with lock:
+                        self._n += 1
+
+                def looped(self, locks):
+                    lock = self._lock
+                    for lock in locks:
+                        with lock:
+                            self._n += 1
+
+                def raw(self):
+                    self._n += 1
+        """))
+        runner = Runner([LockDisciplineChecker()], root=str(tmp_path))
+        res = runner.run([str(tmp_path)])
+        assert [x.code for x in res.failures] == []
+        g = runner.graph()
+        for meth in ("Box.unpacked", "Box.looped"):
+            assert g.functions[("pkg/a.py", meth)].acquires == []
+
+
+class TestInheritance:
+    """``self.method()`` resolves through base classes, and a
+    base-class lock acquired from a subclass canonicalizes to the
+    defining class — the PR-10 gridconc follow-up."""
+
+    def test_inherited_method_call_edge_resolves(self, tmp_path):
+        g = _graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/base.py": """
+                class Handler:
+                    def _decode(self, frame):
+                        return frame
+            """,
+            "pkg/sub.py": """
+                from pkg.base import Handler
+
+                class WsHandler(Handler):
+                    def on_frame(self, frame):
+                        return self._decode(frame)
+            """,
+        })
+        on_frame = g.functions[("pkg/sub.py", "WsHandler.on_frame")]
+        targets = [t for c in on_frame.calls for t in c.targets]
+        assert ("pkg/base.py", "Handler._decode") in targets
+
+    def test_base_lock_canonicalizes_to_the_defining_class(self, tmp_path):
+        g = _graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/base.py": """
+                import threading
+
+                class Service:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+            """,
+            "pkg/sub.py": """
+                from pkg.base import Service
+
+                class Engine(Service):
+                    def work(self):
+                        with self._lock:
+                            pass
+            """,
+        })
+        work = g.functions[("pkg/sub.py", "Engine.work")]
+        # ONE lock, owned by the base that constructs it — not a
+        # phantom second lock owned by the subclass
+        assert [a.lock for a in work.acquires] == [
+            ("pkg/base.py", "Service", "_lock")
+        ]
+
+    def test_domains_propagate_into_inherited_methods(self, tmp_path):
+        g = _graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/base.py": """
+                class Handler:
+                    def _decode(self, frame):
+                        return frame
+            """,
+            "pkg/sub.py": """
+                from pkg.base import Handler
+
+                class WsHandler(Handler):
+                    async def on_frame(self, frame):
+                        return self._decode(frame)
+            """,
+        })
+        assert "loop" in g.domains_of(("pkg/base.py", "Handler._decode"))
+
+
 class TestDomains:
     def test_entry_points_and_propagation(self, tmp_path):
         g = _graph(tmp_path, {

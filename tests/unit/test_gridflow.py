@@ -1,0 +1,637 @@
+"""gridtaint — the GL6 dataflow family and the flow engine under it.
+
+Part 1 exercises the engine's propagation machinery directly through
+fixture trees: returns, f-strings/``%``/``.format``, dict/list
+literals, attribute stores, interprocedural summaries, and sanitizer
+kills — because GL601–604 are only as good as these channels.
+
+Part 2 asserts each GL6 rule fires on a known-bad snippet AND stays
+quiet on a known-good one.
+
+Part 3 runs repo-scale invariants on the real tree: the flight
+recorder's dump path is sanitized (every embedded structure passes
+through ``redact()``), the credential vocabulary stays in lockstep
+with the recorder's ``_REDACT_KEYS``, and the serving engine's block
+accounting stays GL603-clean.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+from pygrid_tpu.analysis import run_checks
+from pygrid_tpu.analysis.checkers.gl6_flow import DataFlowChecker
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _lint(tmp_path, files):
+    (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+    for path, text in files.items():
+        f = tmp_path / path
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(text))
+    return run_checks(
+        [str(tmp_path)], checkers=[DataFlowChecker()], baseline_path="",
+        root=str(tmp_path),
+    )
+
+
+def _codes(result):
+    return sorted(f.code for f in result.failures)
+
+
+def _logged(body: str) -> str:
+    """A fixture module with the logging prelude; the body is dedented
+    HERE so mixed-indentation concatenation never confuses dedent."""
+    return (
+        "import logging\n"
+        "logger = logging.getLogger(__name__)\n"
+        + textwrap.dedent(body)
+    )
+
+
+# ── part 1: propagation channels ─────────────────────────────────────────
+
+
+class TestPropagation:
+    def test_through_returns_and_call_hop(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            def _describe(report):
+                return f"report={report}"
+
+            async def handler(request):
+                body = await request.json()
+                logger.info(_describe(body))
+        """)})
+        assert _codes(res) == ["GL601"]
+        w = " ".join(res.failures[0].witness)
+        assert "request.json" in w and "logger.info" in w
+
+    def test_through_percent_and_format(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            async def h1(request):
+                body = await request.json()
+                logger.info("r=%s" % body)
+
+            async def h2(request):
+                body = await request.json()
+                logger.info("r={}".format(body))
+        """)})
+        assert _codes(res) == ["GL601", "GL601"]
+
+    def test_through_container_literals(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            async def h1(request):
+                body = await request.json()
+                logger.info({"req": body})
+
+            async def h2(request):
+                body = await request.json()
+                logger.info([body, "tail"])
+        """)})
+        assert _codes(res) == ["GL601", "GL601"]
+
+    def test_through_attribute_stores(self, tmp_path):
+        """``self._x = tainted`` in one method is observed by a read in
+        ANOTHER method — the field channel."""
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            class Cache:
+                async def put(self, request):
+                    self._last = await request.json()
+
+                def describe(self):
+                    logger.info(self._last)
+        """)})
+        assert _codes(res) == ["GL601"]
+        assert any("stored to self._last" in s
+                   for s in res.failures[0].witness)
+
+    def test_sanitizers_kill_the_flow(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            import hashlib
+
+            def redact(v):
+                return "[redacted]"
+
+            async def handler(request):
+                body = await request.json()
+                logger.info("got %d bytes", len(body))
+                logger.info(redact(body))
+                logger.info(hashlib.sha256(body).hexdigest())
+        """)})
+        assert _codes(res) == []
+
+    def test_unknown_call_result_does_not_inherit_arg_taint(
+        self, tmp_path
+    ):
+        """The response of an HTTP call that took a credential argument
+        is not itself a credential — the precision rule that keeps the
+        client auth stack from flooding."""
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            import requests
+
+            def check(request_key):
+                resp = requests.head("http://x", headers={"k": request_key})
+                logger.info(resp.status_code)
+        """)})
+        assert _codes(res) == []
+
+
+# ── part 2: the GL6 rules, positive and negative ─────────────────────────
+
+
+class TestGL601:
+    def test_payload_into_recorder_note_fires(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            from pkg import recorder
+
+            async def handler(request):
+                body = await request.json()
+                recorder.note("report", detail=body)
+        """, "pkg/recorder.py": """
+            def note(kind, **fields):
+                pass
+        """})
+        assert _codes(res) == ["GL601"]
+
+    def test_length_marker_note_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            from pkg import recorder
+
+            async def handler(request):
+                body = await request.json()
+                recorder.note("report", size=len(body))
+        """, "pkg/recorder.py": """
+            def note(kind, **fields):
+                pass
+        """})
+        assert _codes(res) == []
+
+    def test_checkpoint_bytes_into_telemetry_field_fires(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            from pkg import telemetry
+
+            def publish(mgr):
+                blob = load_encoded("m1")
+                telemetry.record("model_hosted", blob=blob)
+
+            def load_encoded(mid):
+                return b"weights"
+        """, "pkg/telemetry.py": """
+            def record(event, **fields):
+                pass
+        """})
+        assert _codes(res) == ["GL601"]
+
+
+class TestGL602:
+    def test_credential_field_into_metric_label_fires(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            from pkg import telemetry
+
+            def track(msg):
+                key = msg["request_key"]
+                telemetry.incr("reports_total", worker=key)
+        """, "pkg/telemetry.py": """
+            def incr(name, value=1, **labels):
+                pass
+        """})
+        assert _codes(res) == ["GL602"]
+
+    def test_credential_into_exception_message_fires(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            def check(msg):
+                token = msg.get("auth_token")
+                raise PermissionError(f"bad token {token}")
+        """})
+        assert _codes(res) == ["GL602"]
+
+    def test_note_under_redact_keyed_field_is_sanctioned(self, tmp_path):
+        """note(request_key=rk) is the SANCTIONED spelling — the
+        dump-time key redactor covers it; the same value baked into an
+        f-string under an innocent key is the leak."""
+        res = _lint(tmp_path, {"pkg/a.py": """
+            from pkg import recorder
+
+            def good(msg):
+                recorder.note("auth", request_key=msg["request_key"])
+
+            def bad(msg):
+                recorder.note("auth", detail=f"key={msg['request_key']}")
+        """, "pkg/recorder.py": """
+            def note(kind, **fields):
+                pass
+        """})
+        assert _codes(res) == ["GL602"]
+        assert res.failures[0].line >= 7  # the f-string site, not good()
+
+    def test_hashed_credential_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            import hashlib
+
+            def track(msg):
+                key = msg["request_key"]
+                logger.info(hashlib.sha256(key.encode()).hexdigest())
+        """)})
+        assert _codes(res) == []
+
+
+class TestGL603:
+    def test_alloc_leaked_on_early_return_fires(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            class Engine:
+                def grab(self, ok):
+                    pages = self._pool.alloc(4)
+                    if pages is None:
+                        return False
+                    if not ok:
+                        return False
+                    self._pool.release(pages)
+                    return True
+        """})
+        assert _codes(res) == ["GL603"]
+        assert "return path" in res.failures[0].message
+
+    def test_alloc_leaked_on_exception_path_fires(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            class Engine:
+                def grab(self, ok):
+                    pages = self._pool.alloc(4)
+                    if not ok:
+                        raise RuntimeError("mid-assign failure")
+                    self._pool.release(pages)
+        """})
+        assert _codes(res) == ["GL603"]
+        assert "exception path" in res.failures[0].message
+
+    def test_try_finally_release_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            class Engine:
+                def grab(self, ok):
+                    pages = self._pool.alloc(4)
+                    if pages is None:
+                        return False
+                    try:
+                        if not ok:
+                            raise RuntimeError("x")
+                    finally:
+                        self._pool.release(pages)
+                    return True
+        """})
+        assert _codes(res) == []
+
+    def test_ownership_transfer_is_quiet(self, tmp_path):
+        """Storing the pages (the engine's ``row.pages = shared +
+        priv``) or handing them to a callee transfers ownership."""
+        res = _lint(tmp_path, {"pkg/a.py": """
+            class Engine:
+                def assign(self, row):
+                    priv = self._pool.alloc(4)
+                    if priv is None:
+                        return False
+                    row.pages = row.shared + priv
+                    return True
+
+                def hand_off(self):
+                    sock = socket.create_connection(("h", 1))
+                    self._adopt(sock)
+        """})
+        assert _codes(res) == []
+
+    def test_socket_and_tempfile_leaks_fire(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            import socket
+            import tempfile
+
+            def probe(host):
+                sock = socket.create_connection((host, 80))
+                return sock.recv(1)
+
+            def scratch(log):
+                fd, path = tempfile.mkstemp()
+                log.last_scratch = True
+        """})
+        # probe leaks the socket on its return (``sock.recv(1)`` USES
+        # the socket, it does not transfer ownership); scratch falls
+        # off the end with the fd/path pair neither closed nor handed
+        # anywhere
+        assert _codes(res) == ["GL603", "GL603"]
+
+    def test_multi_path_leak_reports_the_acquire_once(self, tmp_path):
+        """Two leaking paths out of ONE acquire = one finding — a
+        baselined allowance of 1 must not break when someone adds
+        another early return to the same function."""
+        res = _lint(tmp_path, {"pkg/a.py": """
+            import socket
+
+            def probe(host, fast):
+                sock = socket.create_connection((host, 80))
+                if fast:
+                    return 1
+                return 2
+        """})
+        assert _codes(res) == ["GL603"]
+
+    def test_non_with_lock_acquire_must_release(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            class Box:
+                def bad(self):
+                    self._lock.acquire()
+                    self._n += 1
+
+                def good(self):
+                    self._lock.acquire()
+                    try:
+                        self._n += 1
+                    finally:
+                        self._lock.release()
+        """})
+        assert _codes(res) == ["GL603"]
+        assert "bad" in res.failures[0].message
+
+
+class TestGL604:
+    def test_untyped_raise_reachable_from_route_fires(self, tmp_path):
+        res = _lint(tmp_path, {
+            "pkg/node/routes.py": """
+                from pkg.node import helpers
+
+                async def get_model(request):
+                    return helpers.load(request)
+
+                def setup(r):
+                    r.add_get("/model", get_model)
+            """,
+            "pkg/node/helpers.py": """
+                def load(request):
+                    raise ValueError("bad id")
+            """,
+        })
+        assert _codes(res) == ["GL604"]
+        assert res.failures[0].path.endswith("helpers.py")
+        w = " ".join(res.failures[0].witness)
+        assert "get_model" in w and "raise ValueError" in w
+
+    def test_intervening_catch_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, {
+            "pkg/node/routes.py": """
+                from pkg.node import helpers
+
+                async def get_model(request):
+                    try:
+                        return helpers.load(request)
+                    except ValueError as err:
+                        return {"error": str(err)}
+
+                def setup(r):
+                    r.add_get("/model", get_model)
+            """,
+            "pkg/node/helpers.py": """
+                def load(request):
+                    raise ValueError("bad id")
+            """,
+        })
+        assert _codes(res) == []
+
+    def test_typed_pygrid_error_is_quiet(self, tmp_path):
+        """A PyGridError subclass — through an inheritance hop — is the
+        typed contract, not an escape."""
+        res = _lint(tmp_path, {
+            "pkg/node/routes.py": """
+                from pkg.errors import ModelNotFoundError
+
+                async def get_model(request):
+                    raise ModelNotFoundError("no such model")
+
+                def setup(r):
+                    r.add_get("/model", get_model)
+            """,
+            "pkg/errors.py": """
+                class PyGridError(Exception):
+                    pass
+
+                class NotFoundError(PyGridError):
+                    pass
+
+                class ModelNotFoundError(NotFoundError):
+                    pass
+            """,
+        })
+        assert _codes(res) == []
+
+    def test_ws_routes_dict_is_an_entry_point(self, tmp_path):
+        res = _lint(tmp_path, {
+            "pkg/node/events.py": """
+                def report(ctx, msg, conn):
+                    raise KeyError(msg["id"])
+
+                ROUTES = {"model-centric/report": report}
+            """,
+        })
+        assert _codes(res) == ["GL604"]
+
+    def test_dict_merged_handler_tables_are_entry_points(self, tmp_path):
+        """The repo's real shape: USER_HANDLERS defined in
+        users/events.py and ``**``-merged into node/events.py's ROUTES —
+        the merge spells ``key=None`` in the AST, so the handlers must
+        enter where their table is DEFINED (the GL404-parity case the
+        first review of this rule caught)."""
+        res = _lint(tmp_path, {
+            "pkg/users/events.py": """
+                def signup_user(ctx, msg):
+                    raise ValueError("missing email")
+
+                USER_HANDLERS = {"user.signup": signup_user}
+            """,
+            "pkg/node/events.py": """
+                from pkg.users.events import USER_HANDLERS
+
+                def report(ctx, msg, conn):
+                    return {}
+
+                ROUTES = {"model-centric/report": report, **USER_HANDLERS}
+            """,
+        })
+        assert _codes(res) == ["GL604"]
+        assert res.failures[0].path.endswith("users/events.py")
+
+    def test_factory_wrapped_registration_enters_via_the_factory(
+        self, tmp_path
+    ):
+        """``add_post("/x", make_handler(EVENT))`` registers a closure
+        the graph cannot index — the factory body is the reachable
+        raising surface and must be analyzed."""
+        res = _lint(tmp_path, {
+            "pkg/node/routes.py": """
+                def make_handler(event):
+                    if not event:
+                        raise ValueError("empty event")
+                    async def handler(request):
+                        return {}
+                    return handler
+
+                def setup(r):
+                    r.add_post("/users/signup", make_handler("user.signup"))
+            """,
+        })
+        assert _codes(res) == ["GL604"]
+
+    def test_catch_of_base_class_covers_subclass_raise(self, tmp_path):
+        """``except LookupError`` covers a KeyError raise (builtin
+        hierarchy), and ``except Exception`` covers everything."""
+        res = _lint(tmp_path, {
+            "pkg/node/events.py": """
+                def report(ctx, msg, conn):
+                    try:
+                        _inner(msg)
+                    except LookupError:
+                        return {"error": "missing"}
+
+                def _inner(msg):
+                    raise KeyError(msg["id"])
+
+                ROUTES = {"model-centric/report": report}
+            """,
+        })
+        assert _codes(res) == []
+
+
+# ── part 3: repo-scale invariants ────────────────────────────────────────
+
+
+class TestRepoScale:
+    def test_credential_vocabulary_matches_the_recorder(self):
+        """The static analysis and the runtime redactor must agree on
+        what a credential-bearing key looks like."""
+        from pygrid_tpu.analysis.flow import CREDENTIAL_KEYS
+        from pygrid_tpu.telemetry.recorder import _REDACT_KEYS
+
+        assert set(CREDENTIAL_KEYS) == set(_REDACT_KEYS)
+
+    def test_recorder_dump_paths_are_sanitized(self):
+        """On the real tree: every structure the flight recorder embeds
+        in a dump rides through ``redact()`` — the engine must see the
+        sanitizer (no GL601/GL602 sited in the recorder), and removing
+        the redact wrap must be DETECTABLE (the fixture twin fires)."""
+        from pygrid_tpu.analysis.core import Runner
+        from pygrid_tpu.analysis.flow import FlowEngine
+
+        runner = Runner([], root=str(REPO_ROOT))
+        runner.run([str(REPO_ROOT / "pygrid_tpu")])
+        engine = FlowEngine(runner.graph())
+        recorder_hits = [
+            h for h in engine.hits
+            if h.rel_path.endswith("telemetry/recorder.py")
+        ]
+        assert recorder_hits == [], [
+            (h.tag, h.sink.desc, h.chain) for h in recorder_hits
+        ]
+
+    def test_unredacted_dump_twin_fires(self, tmp_path):
+        """The same dump shape WITHOUT the redact pass is caught — the
+        repo-scale pass above is meaningful, not vacuous."""
+        res = _lint(tmp_path, {"pkg/rec.py": _logged("""
+            class Recorder:
+                async def capture(self, request):
+                    self._snapshot = await request.json()
+
+                def dump(self):
+                    logger.error({"snapshot": self._snapshot})
+        """)})
+        assert _codes(res) == ["GL601"]
+
+    def test_serving_engine_block_accounting_is_gl603_clean(self):
+        from pygrid_tpu.analysis.core import Runner
+        from pygrid_tpu.analysis.flow import resource_findings
+
+        runner = Runner([], root=str(REPO_ROOT))
+        runner.run([str(REPO_ROOT / "pygrid_tpu")])
+        leaks = [
+            (fn.qualname, kind, why)
+            for fn, node, kind, why in resource_findings(runner.graph())
+            if fn.rel_path.startswith("pygrid_tpu/serving/")
+        ]
+        assert leaks == []
+
+
+# ── CLI: --explain and --format sarif ────────────────────────────────────
+
+
+class TestCLI:
+    def _tree(self, tmp_path):
+        (tmp_path / "pyproject.toml").write_text("[tool.none]\n")
+        f = tmp_path / "pkg" / "a.py"
+        f.parent.mkdir(parents=True)
+        f.write_text(textwrap.dedent("""
+            import logging
+            logger = logging.getLogger(__name__)
+
+            def _describe(report):
+                return f"report={report}"
+
+            async def handler(request):
+                body = await request.json()
+                logger.info(_describe(body))
+        """))
+        return str(tmp_path / "pkg")
+
+    def test_explain_prints_the_witness_chain(self, tmp_path, capsys):
+        from pygrid_tpu.analysis.cli import main
+
+        assert main(
+            [self._tree(tmp_path), "--no-baseline", "--explain", "GL601"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "request.json" in out
+        assert "logger.info" in out
+        assert "┌─" in out  # the chain rendering, not just the summary
+
+    def test_output_writes_json_format_too(self, tmp_path):
+        """--output covers EVERY format, not just sarif — a CI step
+        uploading the file must not upload nothing."""
+        from pygrid_tpu.analysis.cli import main
+
+        out_path = tmp_path / "report.json"
+        rc = main([
+            self._tree(tmp_path), "--no-baseline", "--format", "json",
+            "--output", str(out_path), "-q",
+        ])
+        assert rc == 1
+        doc = json.loads(out_path.read_text())
+        assert doc["failures"] and doc["failures"][0]["code"] == "GL601"
+
+    def test_step_location_regex_handles_gl204_edge_steps(self):
+        """GL204 witness steps carry their provenance AFTER the
+        location — the SARIF step parser must still anchor them."""
+        from pygrid_tpu.analysis.cli import _STEP_LOC
+
+        m = _STEP_LOC.search(
+            "Manager._lock -> Bus._lock acquired at pkg/a.py:10 "
+            "(call edge)"
+        )
+        assert m is not None and m.group(1) == "pkg/a.py"
+        assert m.group(2) == "10"
+
+    def test_sarif_carries_code_flows(self, tmp_path):
+        from pygrid_tpu.analysis.cli import main
+
+        out_path = tmp_path / "report.sarif"
+        rc = main([
+            self._tree(tmp_path), "--no-baseline", "--format", "sarif",
+            "--output", str(out_path), "-q",
+        ])
+        assert rc == 1  # the finding fails the run; the report persists
+        doc = json.loads(out_path.read_text())
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"GL601", "GL602", "GL603", "GL604"} <= rules
+        results = run["results"]
+        assert len(results) == 1 and results[0]["ruleId"] == "GL601"
+        flow = results[0]["codeFlows"][0]["threadFlows"][0]["locations"]
+        assert len(flow) >= 2  # source step + sink step at minimum
+        texts = " ".join(l["location"]["message"]["text"] for l in flow)
+        assert "request.json" in texts

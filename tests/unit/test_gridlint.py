@@ -1021,22 +1021,18 @@ class TestGL4:
         assert _codes(res) == ["GL403"]
         assert "pygrid.wire.v3" in res.failures[0].message
 
-    def test_bare_raise_in_handler_module_fires_GL404(self, tmp_path):
+    def test_GL404_is_superseded_no_module_path_heuristic(self, tmp_path):
+        """GL404's 'bare raise in a handler FILE' heuristic is gone —
+        GL604 (test_gridflow.py) replaces it with whole-program
+        reachability, so a raise in a handler module that no route can
+        reach stays quiet."""
         res = _lint(tmp_path, None, ContractDriftChecker, files={
             "pkg/node/events.py": """
-                def handler(ctx, message, conn):
-                    if "x" not in message:
-                        raise ValueError("missing x")
-                    return {}
-            """,
-            # the same raise OUTSIDE a handler module is not GL4's business
-            "pkg/smpc/kernels.py": """
-                def kernel(x):
-                    raise ValueError("shape mismatch")
+                def dead_helper(ctx, message, conn):
+                    raise ValueError("missing x")
             """,
         })
-        assert _codes(res) == ["GL404"]
-        assert res.failures[0].path.endswith("node/events.py")
+        assert _codes(res) == []
 
     def test_without_docs_dir_membership_rules_stay_quiet(self, tmp_path):
         res = _lint(tmp_path, """
