@@ -1528,4 +1528,39 @@ def boundary_entry_points(graph: ProgramGraph) -> dict[tuple, str]:
                             out.setdefault(
                                 hit, f"WS event handler ({rel})"
                             )
+    # explicit annotations: any module (not just the pattern-listed
+    # route modules) may declare a module-level GRIDLINT_ENTRY_POINTS
+    # tuple/list of function names — protocol boundaries the heuristics
+    # can't see, like the sub-aggregator's raw-WS server. Names are
+    # either qualnames in the same module ("Cls.method", "fn") or
+    # call-style dotted names resolved through the graph.
+    for rel, syms in graph.modules.items():
+        for stmt in syms.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign):
+                targets = [stmt.target]
+            if not any(
+                isinstance(t, ast.Name) and t.id == "GRIDLINT_ENTRY_POINTS"
+                for t in targets
+            ):
+                continue
+            value = stmt.value
+            if not isinstance(value, (ast.Tuple, ast.List)):
+                continue
+            for elt in value.elts:
+                if not (
+                    isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)
+                ):
+                    continue
+                name = elt.value
+                hits = []
+                if (rel, name) in graph.functions:
+                    hits = [(rel, name)]
+                else:
+                    hits = graph.resolve_call(rel, None, name, None)
+                for hit in hits:
+                    out.setdefault(hit, f"annotated entry point ({rel})")
     return out

@@ -40,6 +40,16 @@ class WSConnectionClosed(ConnectionError):
     """The server closed the websocket (close frame or EOF)."""
 
 
+#: Fault-injection shim (pygrid_tpu/storm): when set, called as
+#: ``CHAOS_HOOK(direction, nbytes)`` with direction ``"send"`` before a
+#: data frame hits the socket and ``"recv"`` at recv() entry. The hook
+#: may sleep (slow link) or raise :class:`WSConnectionClosed` (cut
+#: link — a ConnectionError, so every existing close/retry path applies
+#: unchanged). None in production; never wrap control frames, which
+#: would distort close handshakes.
+CHAOS_HOOK = None
+
+
 class WSTimeout(TimeoutError):
     """No complete message arrived within the recv timeout."""
 
@@ -203,6 +213,8 @@ class RawWSClient:
     # ── send ─────────────────────────────────────────────────────────────────
 
     def _send_frame(self, opcode: int, payload: bytes) -> None:
+        if CHAOS_HOOK is not None and opcode in (OP_TEXT, OP_BINARY):
+            CHAOS_HOOK("send", len(payload))
         # masking hides frames from broken transparent proxies, not from
         # adversaries (RFC 6455 §10.3) — the PRNG mask is fine and skips a
         # urandom syscall per frame
@@ -264,6 +276,8 @@ class RawWSClient:
         read inside a frame, so neither a slow trickle of fragments, a
         ping storm, nor a byte-at-a-time payload can stretch one recv
         far past the requested budget."""
+        if CHAOS_HOOK is not None:
+            CHAOS_HOOK("recv", 0)
         self._deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )

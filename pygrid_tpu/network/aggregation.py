@@ -86,6 +86,20 @@ class AggregationRegistry:
     def remove(self, subagg_id: str) -> bool:
         return self._entries.pop(subagg_id, None) is not None
 
+    def expire(self, subagg_id: str) -> bool:
+        """FAULT INJECTION (pygrid_tpu/storm): back-date one entry's
+        heartbeat past the TTL so the registry sees a silent death NOW
+        instead of waiting out ``ttl_s`` — the kill-subagg fault uses
+        this to make "stops heartbeating" and "loses placement" land in
+        the same scenario tick. Production death detection stays purely
+        heartbeat-driven; this only manipulates the clock, not the
+        expiry logic, so ``live``/``sweep`` exercise their real paths."""
+        entry = self._entries.get(subagg_id)
+        if entry is None:
+            return False
+        entry.last_seen = time.monotonic() - self.ttl_s - 1.0
+        return True
+
     def live(self, node_address: str | None = None) -> list[SubAggEntry]:
         """Placement-eligible entries, optionally for one upstream,
         in stable (id-sorted) order so the hash placement is

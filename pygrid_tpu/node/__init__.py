@@ -101,6 +101,10 @@ class NodeContext:
         from pygrid_tpu.telemetry.slo import SLOEngine, node_objectives
 
         self.slo = SLOEngine(node_objectives())
+        #: failpoint (pygrid_tpu/storm slow_node fault): seconds of
+        #: artificial delay injected into the /data-centric/status/
+        #: heartbeat — 0.0 (off) outside chaos drills
+        self.chaos_status_delay_s = 0.0
 
     def all_stores(self):
         """The node's singleton store plus every live session worker's store —

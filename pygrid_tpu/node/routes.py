@@ -11,6 +11,7 @@ the users HTTP CRUD. Status codes mirror the reference: 400 bad request,
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import json
 import logging
@@ -466,6 +467,12 @@ async def dc_status(request: web.Request) -> web.Response:
 
     from pygrid_tpu.utils.profiling import stats
 
+    # failpoint (pygrid_tpu/storm slow_node fault): the monitor's HTTP
+    # heartbeat fallback lands here, so an injected delay is seen by the
+    # network as real RTT degradation — 0.0 in production
+    delay = getattr(_ctx(request), "chaos_status_delay_s", 0.0)
+    if delay:
+        await asyncio.sleep(delay)
     return web.json_response(
         {
             "status": "OK",

@@ -187,6 +187,27 @@ class ServingManager:
             engines = [e for _, e in self._engines.values()]
         return [e.stats() for e in engines]
 
+    def engines(self) -> dict[str, GenerationEngine]:
+        """Live engines by model id — the storm fault plane's handle
+        (chaos_hold_blocks etc.); everyone else should go through
+        :meth:`engine_for`."""
+        with self._lock:
+            return {mid: e for mid, (_, e) in self._engines.items()}
+
+    def ledger(self) -> dict:
+        """Node-wide leak ledger: every engine's block accounting (see
+        :meth:`~pygrid_tpu.serving.engine.GenerationEngine.ledger`) plus
+        the node verdict — ``balanced`` is True only when EVERY engine's
+        ledger closes. Integration tests and the storm harness assert
+        this after traffic drains instead of poking pool internals."""
+        with self._lock:
+            engines = [e for _, e in self._engines.values()]
+        per_engine = [e.ledger() for e in engines]
+        return {
+            "engines": per_engine,
+            "balanced": all(led["balanced"] for led in per_engine),
+        }
+
     def close(self) -> None:
         with self._lock:
             engines = [e for _, e in self._engines.values()]

@@ -343,6 +343,10 @@ class GenerationEngine:
         self._thread: threading.Thread | None = None
         self._requests = 0
         self._tokens_out = 0
+        #: blocks withheld from the pool by fault injection
+        #: (chaos_hold_blocks) — never visible to admission, always
+        #: accounted for by ledger() so a forgotten hold reads as a leak
+        self._chaos_blocks: list[int] = []
 
     # ── client surface (any thread) ─────────────────────────────────────
 
@@ -564,6 +568,85 @@ class GenerationEngine:
                     }
                 )
             return out
+
+    def ledger(self) -> dict:
+        """Leak-ledger snapshot: where every usable KV block is right
+        now, plus the drain invariant. After traffic drains (no queue,
+        no live slots, no chaos holds) every block must be either free
+        or parked in the prefix cache — ``free + cached == usable`` —
+        or some failure path leaked a reference. This is the dynamic
+        twin of the GL603 static discipline; the storm harness asserts
+        ``balanced`` after every scenario."""
+        with self._lock:
+            queue_depth = len(self._queue)
+            live = self._live
+            if not self._paged:
+                return {
+                    "model_id": self.model_id,
+                    "paged": False,
+                    "queue_depth": queue_depth,
+                    "live_slots": live,
+                    "balanced": queue_depth == 0 and live == 0,
+                }
+            pool = self._pool.ledger()
+            cached = self._prefix.block_count()
+            chaos = len(self._chaos_blocks)
+            drained = (
+                queue_depth == 0
+                and live == 0
+                and chaos == 0
+                and self._demand_pages == 0
+            )
+            return {
+                "model_id": self.model_id,
+                "paged": True,
+                "queue_depth": queue_depth,
+                "live_slots": live,
+                "demand_pages": self._demand_pages,
+                "usable": pool["usable"],
+                "free": pool["free"],
+                "held": pool["held"],
+                "cached": cached,
+                "retired": pool["retired"],
+                "chaos_held": chaos,
+                "drained": drained,
+                # not-drained engines are balanced as long as the pool's
+                # own accounting closes; once drained the stronger
+                # cache-only invariant must hold too
+                "balanced": pool["balanced"]
+                and (not drained or pool["free"] + cached == pool["usable"]),
+            }
+
+    # ── fault plane (pygrid_tpu/storm) ──────────────────────────────────
+
+    def chaos_hold_blocks(self, n: int | None = None) -> int:
+        """FAULT INJECTION: withdraw up to ``n`` free blocks (all of
+        them when None) from the pool, starving admission the way a
+        burst of long-context requests would. Returns how many are now
+        held. Release with :meth:`chaos_release_blocks`; ledger() counts
+        the holds so they can never masquerade as a clean drain."""
+        if not self._paged:
+            return 0
+        grabbed: list[int] = []
+        while n is None or len(grabbed) < n:
+            got = self._pool.alloc(1)
+            if got is None:
+                break
+            grabbed.extend(got)
+        with self._lock:
+            self._chaos_blocks.extend(grabbed)
+            return len(self._chaos_blocks)
+
+    def chaos_release_blocks(self) -> int:
+        """Undo :meth:`chaos_hold_blocks`; returns how many blocks went
+        back to the pool."""
+        with self._lock:
+            held, self._chaos_blocks = self._chaos_blocks, []
+        if held:
+            self._pool.release(held)
+            with self._work:
+                self._work.notify_all()
+        return len(held)
 
     def compile_count(self) -> int:
         return self.programs.compile_count()
@@ -1225,6 +1308,10 @@ class GenerationEngine:
                 self._pool = pagedkv.BlockPool(self._num_blocks)
                 # gridlint: disable-next=GL202 — engine-thread-confined swap, requests already failed
                 self._prefix = pagedkv.PrefixCache(self._pool, self._block)
+                # chaos holds named the OLD pool; releasing those ids
+                # against the fresh allocator would be a refcount bug
+                # gridlint: disable-next=GL202 — engine-thread-confined swap, requests already failed
+                self._chaos_blocks = []
             else:
                 # clean close: refcounts must balance exactly (the
                 # leak test rides on this) — release each admitted

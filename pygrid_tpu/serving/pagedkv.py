@@ -277,6 +277,23 @@ class BlockPool:
         with self._lock:
             return int(self._ref[block])
 
+    def ledger(self) -> dict:
+        """One-lock-acquisition accounting snapshot. Every usable block
+        is either free or held by someone — ``free + held == usable`` is
+        the pool-level leak invariant the storm harness (and GL603's
+        dynamic twin) asserts after traffic drains."""
+        with self._lock:
+            free = len(self._free)
+            held = int((self._ref[1:] > 0).sum())
+            usable = self.num_blocks - 1 - self._retired
+            return {
+                "usable": usable,
+                "free": free,
+                "held": held,
+                "retired": self._retired,
+                "balanced": free + held == usable,
+            }
+
 
 class _PrefixNode:
     __slots__ = ("block", "parent", "children", "key")

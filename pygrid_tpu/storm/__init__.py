@@ -1,0 +1,50 @@
+"""gridstorm: open-loop load generation, fault injection, and replay.
+
+ROADMAP headline #5. Every resilience mechanism in the grid — the SLO
+engine and its breach webhooks, degraded-node routing, sub-aggregator
+expiry + direct-report fallback, the paged-KV leak ledger, the flight
+recorder — is exercised here under one roof, against a REAL topology
+(aiohttp servers on localhost event-loop threads, real websockets, the
+same codepaths production runs), and the harness asserts the system's
+*reaction*, not just its survival:
+
+- a deliberately injected fault is detected as an SLO breach within a
+  bounded number of monitor ticks (``slo_breach_detect_seconds``),
+- the monitor flips a slow node to ``degraded`` and placement routes
+  around a killed sub-aggregator (workers fall back to direct reports),
+- the system returns to compliance after the fault clears, and
+- the leak ledgers balance — zero stuck slots, cycles, or KV blocks.
+
+Three legs (docs/STORM.md):
+
+- :mod:`pygrid_tpu.storm.scenarios` — declarative scenario specs
+  (dict/YAML, deterministic seed) + the built-in registry,
+- :mod:`pygrid_tpu.storm.loadgen` — the open-loop traffic engine and
+  topology builder (:class:`~pygrid_tpu.storm.loadgen.StormHarness`),
+- :mod:`pygrid_tpu.storm.faults` — the fault plane, scheduled on the
+  scenario clock,
+- :mod:`pygrid_tpu.storm.assertions` — reaction verdicts over the run,
+- :mod:`pygrid_tpu.storm.replay` — re-drive a flight-recorder dump
+  captured during a storm as a regression scenario.
+
+CLI: ``python -m pygrid_tpu.storm --scenario smoke`` (or
+``scripts/gridstorm.sh --smoke``).
+"""
+
+from __future__ import annotations
+
+from pygrid_tpu.storm.scenarios import (  # noqa: F401
+    FaultSpec,
+    StormScenario,
+    TrafficSpec,
+    builtin_scenarios,
+    get_scenario,
+)
+
+__all__ = [
+    "FaultSpec",
+    "StormScenario",
+    "TrafficSpec",
+    "builtin_scenarios",
+    "get_scenario",
+]

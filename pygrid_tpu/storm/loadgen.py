@@ -1,0 +1,713 @@
+"""Open-loop load generation against a real in-process grid.
+
+The topology is the integration harness's fake-cluster strategy promoted
+to a subsystem: every server is a real aiohttp app on its own event-loop
+thread, joined over real localhost sockets — node(s), one network (with
+its monitor loop at scenario cadence), and sub-aggregator(s) registered
+for placement. Traffic is OPEN loop: each leg's arrival times are a
+Poisson process derived from the scenario seed (``random.Random`` seeded
+with a string — deterministic across processes, unlike ``hash``), so a
+replay regenerates the identical schedule.
+
+Legs
+----
+- ``fl``: a full worker round — authenticate, cycle-request, placement
+  lookup, report through the sub-aggregator tree (or direct fallback).
+  Executed serially per leg: cycle completion racing is real protocol
+  behavior and shows up as typed ``stale`` outcomes, never errors.
+- ``generation``: remote autoregressive generation with a shared prompt
+  prefix (exercises admission, the paged pool, and the prefix cache).
+- ``datacentric``: pointer round trip — send a tensor, search its tag,
+  fetch-and-delete.
+- ``smpc``: fixed-precision secret sharing across two nodes, one linear
+  op, reconstruct.
+
+The harness (:class:`StormHarness`) runs scenario → faults → assertions
+and captures a replayable flight-recorder dump (storm/replay.py).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import logging
+import os
+import random
+import socket
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from pygrid_tpu.telemetry import recorder
+from pygrid_tpu.telemetry import slo as slo_mod
+
+logger = logging.getLogger(__name__)
+
+#: generation model hosted by the topology
+GEN_MODEL_ID = "storm-gen"
+
+#: FL model geometry (tiny: the storm measures the protocol plane, not
+#: the device plane)
+_D, _H, _C, _B = 8, 4, 3, 4
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class AppServer:
+    """One aiohttp application on a dedicated event-loop thread (the
+    integration conftest's ServerThread, packaged so the storm CLI can
+    run outside pytest)."""
+
+    def __init__(self, app, port: int) -> None:
+        import asyncio
+
+        self.app = app
+        self.port = port
+        self.url = f"http://127.0.0.1:{port}"
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        import asyncio
+
+        from aiohttp import web
+
+        asyncio.set_event_loop(self._loop)
+
+        async def _start():
+            runner = web.AppRunner(self.app)
+            await runner.setup()
+            site = web.TCPSite(
+                runner, "127.0.0.1", self.port, shutdown_timeout=1.0
+            )
+            await site.start()
+            self._runner = runner
+            self._started.set()
+
+        self._loop.run_until_complete(_start())
+        self._loop.run_forever()
+
+    def start(self) -> "AppServer":
+        self._thread.start()
+        if not self._started.wait(timeout=15):
+            raise RuntimeError("storm server failed to start")
+        return self
+
+    def stop(self) -> None:
+        import asyncio
+
+        async def _cleanup():
+            await self._runner.cleanup()
+
+        fut = asyncio.run_coroutine_threadsafe(_cleanup(), self._loop)
+        try:
+            fut.result(timeout=10)
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+
+
+def arrival_times(
+    seed: int, leg_index: int, rate_hz: float, start_s: float, stop_s: float
+) -> list[float]:
+    """Poisson arrival times on the scenario clock. Seeded by a STRING
+    (CPython hashes str seeds deterministically, no PYTHONHASHSEED
+    dependence) so the schedule is identical in a replay."""
+    rng = random.Random(f"storm:{seed}:leg:{leg_index}")
+    t = float(start_s)
+    out: list[float] = []
+    while True:
+        t += rng.expovariate(rate_hz)
+        if t >= stop_s:
+            return out
+        out.append(t)
+
+
+@dataclasses.dataclass
+class OpRecord:
+    leg: str
+    index: int
+    start_s: float      # scenario clock
+    end_s: float
+    outcome: str        # ok | busy | stale | rejected | error
+    detail: str = ""
+
+
+class StormTopology:
+    """A real grid built to scenario sizes: network + monitor loop,
+    node(s), sub-aggregator(s), one hosted FL process per fl leg, one
+    served generation bundle. All handles stay in-process so the fault
+    plane and the assertions can reach contexts directly."""
+
+    def __init__(self, scenario) -> None:
+        self.scenario = scenario
+        self.network: AppServer | None = None
+        self.nodes: list[AppServer] = []
+        self.subaggs: list[AppServer] = []
+        self.fl_names: list[str] = []
+        self.fl_blob: bytes | None = None
+        self._prev_sync = None
+
+    # ── build ───────────────────────────────────────────────────────────
+
+    def build(self) -> "StormTopology":
+        from pygrid_tpu.federated import tasks
+        from pygrid_tpu.network import create_app as create_network_app
+        from pygrid_tpu.node import create_app as create_node_app
+        from pygrid_tpu.worker.subagg import create_subagg_app
+
+        import requests
+
+        spec = self.scenario
+        self._prev_sync = tasks._sync
+        tasks.set_sync(True)  # deterministic aggregation inside reports
+        self.network = AppServer(
+            create_network_app(
+                "storm-network", monitor_interval=spec.monitor_interval_s
+            ),
+            _free_port(),
+        ).start()
+        self.network_ctx.aggregation.ttl_s = spec.agg_ttl_s
+        for i in range(spec.nodes):
+            server = AppServer(
+                create_node_app(f"storm-n{i}"), _free_port()
+            ).start()
+            server.app["node"].address = server.url
+            resp = requests.post(
+                self.network.url + "/join",
+                json={
+                    "node-id": f"storm-n{i}",
+                    "node-address": server.url,
+                },
+                timeout=10,
+            )
+            if resp.status_code != 200:
+                raise RuntimeError(f"node join failed: {resp.text}")
+            self.nodes.append(server)
+        # every sub-aggregator fronts node 0 — the FL node — so killing
+        # one forces placement onto the survivor (or direct fallback)
+        for _ in range(spec.subaggs):
+            app = create_subagg_app(
+                self.nodes[0].url,
+                fanout=8,
+                flush_interval=0.2,
+                network_url=self.network.url,
+                register_interval=0.2,
+            )
+            server = AppServer(app, _free_port()).start()
+            app["subagg"].address = server.url
+            self.subaggs.append(server)
+        self._host_fl()
+        self._host_generation()
+        return self
+
+    def _host_fl(self) -> None:
+        import jax
+
+        from pygrid_tpu.client import ModelCentricFLClient
+        from pygrid_tpu.models import mlp
+        from pygrid_tpu.plans.plan import Plan
+        from pygrid_tpu.plans.state import serialize_model_params
+
+        params = [
+            np.asarray(p)
+            for p in mlp.init(jax.random.PRNGKey(5), (_D, _H, _C))
+        ]
+        plan = Plan(name="training_plan", fn=mlp.training_step)
+        plan.build(
+            np.zeros((_B, _D), np.float32),
+            np.zeros((_B, _C), np.float32),
+            np.float32(0.1),
+            *params,
+        )
+        rng = np.random.default_rng(self.scenario.seed)
+        diff = [
+            rng.integers(-3, 4, size=p.shape).astype(np.float32)
+            for p in params
+        ]
+        self.fl_blob = serialize_model_params(diff)
+        fl_legs = [t for t in self.scenario.traffic if t.leg == "fl"]
+        mc = ModelCentricFLClient(self.nodes[0].url)
+        try:
+            for i, _leg in enumerate(fl_legs):
+                name = f"storm-fl-{i}"
+                resp = mc.host_federated_training(
+                    model=params,
+                    client_plans={"training_plan": plan},
+                    client_config={
+                        "name": name, "version": "1.0",
+                        "batch_size": _B, "lr": 0.1, "max_updates": 1,
+                    },
+                    server_config={
+                        "min_workers": 1,
+                        "max_workers": 100_000,
+                        "min_diffs": 8,
+                        "max_diffs": 8,
+                        "num_cycles": 10_000,
+                        "do_not_reuse_workers_until_cycle": 0,
+                        "pool_selection": "random",
+                    },
+                )
+                if resp.get("status") != "success":
+                    raise RuntimeError(f"FL hosting failed: {resp}")
+                self.fl_names.append(name)
+        finally:
+            mc.close()
+
+    def _host_generation(self) -> None:
+        import jax
+
+        from pygrid_tpu.client import DataCentricFLClient
+        from pygrid_tpu.models import decode
+        from pygrid_tpu.models import transformer as T
+
+        cfg = T.TransformerConfig(
+            vocab=37, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            max_len=64,
+        )
+        self.gen_cfg = cfg
+        params = T.init(jax.random.PRNGKey(self.scenario.seed), cfg)
+        client = DataCentricFLClient(self.nodes[0].url)
+        try:
+            out = client.serve_model(
+                decode.bundle(cfg, params), GEN_MODEL_ID,
+                allow_remote_inference=True,
+            )
+            if not out.get("success"):
+                raise RuntimeError(f"serve_model failed: {out}")
+            # warm the engine OUTSIDE the scenario clock: admission +
+            # decode compiles land here, not in the TTFT window
+            client.run_remote_generation(
+                GEN_MODEL_ID, np.array([[1, 2, 3]], np.int32), n_new=2
+            )
+        finally:
+            client.close()
+        # one remote generation only exercises decode width 1; compile
+        # the remaining width/prompt buckets in-process so the first
+        # CONCURRENT ops don't pay XLA inside their TTFT window
+        engine = self.nodes[0].app["node"].serving.engines().get(
+            GEN_MODEL_ID
+        )
+        if engine is not None:
+            engine.warmup((cfg.max_len,))
+
+    # ── handles ─────────────────────────────────────────────────────────
+
+    @property
+    def network_ctx(self):
+        return self.network.app["network"]
+
+    def node_ctx(self, i: int = 0):
+        return self.nodes[i].app["node"]
+
+    def subagg_handle(self, i: int = 0):
+        return self.subaggs[i].app["subagg"]
+
+    def live_subaggs(self) -> list[AppServer]:
+        return [s for s in self.subaggs if s._thread.is_alive()]
+
+    def close(self) -> None:
+        from pygrid_tpu.federated import tasks
+
+        for server in self.subaggs:
+            if server._thread.is_alive():
+                try:
+                    server.stop()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    logger.exception("subagg stop failed")
+        for server in self.nodes:
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                logger.exception("node stop failed")
+        if self.network is not None:
+            try:
+                self.network.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                logger.exception("network stop failed")
+        if self._prev_sync is not None:
+            tasks.set_sync(self._prev_sync)
+
+
+# ── traffic legs ────────────────────────────────────────────────────────
+
+
+class TrafficEngine:
+    """Executes each leg's precomputed arrival schedule against the
+    topology. FL runs serially in its leg thread (protocol ordering);
+    the other legs dispatch into a small pool, so arrivals stay open
+    loop even when an op stalls on a fault."""
+
+    def __init__(self, topology: StormTopology, t0: float) -> None:
+        self.topology = topology
+        self.t0 = t0
+        self.ops: list[OpRecord] = []
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="storm-op"
+        )
+
+    def start(self) -> None:
+        spec = self.topology.scenario
+        stop_default = spec.duration_s
+        for i, leg in enumerate(spec.traffic):
+            schedule = arrival_times(
+                spec.seed, i, leg.rate_hz, leg.start_s,
+                leg.stop_s if leg.stop_s is not None else stop_default,
+            )
+            thread = threading.Thread(
+                target=self._run_leg, args=(i, leg, schedule),
+                name=f"storm-leg-{leg.leg}", daemon=True,
+            )
+            self._threads.append(thread)
+            thread.start()
+
+    def join(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        self._pool.shutdown(wait=True)
+
+    def _record(self, rec: OpRecord) -> None:
+        with self._lock:
+            self.ops.append(rec)
+        recorder.note(
+            "storm.request", leg=rec.leg, index=rec.index,
+            outcome=rec.outcome,
+        )
+
+    def _run_leg(self, leg_index: int, leg, schedule: list[float]) -> None:
+        op = {
+            "fl": self._fl_op,
+            "generation": self._generation_op,
+            "datacentric": self._datacentric_op,
+            "smpc": self._smpc_op,
+        }[leg.leg]
+        serial = leg.leg == "fl"
+        for k, at in enumerate(schedule):
+            delay = self.t0 + at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            if serial:
+                self._execute(op, leg, k)
+            else:
+                self._pool.submit(self._execute, op, leg, k)
+
+    def _execute(self, op, leg, k: int) -> None:
+        start = time.monotonic() - self.t0
+        try:
+            outcome, detail = op(leg, k)
+        except Exception as err:  # noqa: BLE001 — classified below
+            outcome, detail = _classify_error(err)
+        self._record(
+            OpRecord(
+                leg=leg.leg, index=k, start_s=start,
+                end_s=time.monotonic() - self.t0,
+                outcome=outcome, detail=detail,
+            )
+        )
+
+    # ── the ops ─────────────────────────────────────────────────────────
+
+    def _fl_op(self, leg, k: int) -> tuple[str, str]:
+        from pygrid_tpu.client import FLClient
+        from pygrid_tpu.worker import lookup_aggregator
+
+        topo = self.topology
+        name = topo.fl_names[0]
+        node_url = topo.nodes[0].url
+        client = FLClient(node_url, timeout=20.0)
+        try:
+            auth = client.authenticate(name, "1.0")
+            if auth.get("error"):
+                return "error", str(auth["error"])
+            wid = auth["worker_id"]
+            cyc = client.cycle_request(
+                wid, name, "1.0", ping=1.0, download=1000.0, upload=1000.0
+            )
+            if cyc.get("status") != "accepted":
+                return "rejected", str(cyc.get("status"))
+            # placement may name a sub-aggregator that died an instant
+            # ago — the report falls back to direct, which is exactly
+            # the resilience path under test
+            client.aggregator_url = lookup_aggregator(
+                topo.network.url, node_url, wid
+            )
+            out = client.report(
+                wid, cyc["request_key"], topo.fl_blob, model_name=name
+            )
+            if out.get("error"):
+                return _classify_fl_error(str(out["error"]))
+            return "ok", ""
+        finally:
+            client.close()
+
+    def _gen_prompt(self, leg, k: int) -> np.ndarray:
+        """Shared prefix + per-op suffix: op k's prompt is deterministic
+        (replay), and every prompt shares ``prefix_len`` leading tokens
+        so the prefix cache sees real hits."""
+        prefix_len = int(leg.params.get("prefix_len", 8))
+        suffix_len = int(leg.params.get("suffix_len", 3))
+        rng = random.Random(f"storm:gen:{self.topology.scenario.seed}:{k}")
+        vocab = self.topology.gen_cfg.vocab
+        prefix = [(3 * i + 1) % vocab for i in range(prefix_len)]
+        suffix = [rng.randrange(vocab) for _ in range(suffix_len)]
+        return np.array([prefix + suffix], np.int32)
+
+    def _generation_op(self, leg, k: int) -> tuple[str, str]:
+        from pygrid_tpu.client import DataCentricFLClient
+
+        client = DataCentricFLClient(self.topology.nodes[0].url)
+        try:
+            tokens = client.run_remote_generation(
+                GEN_MODEL_ID, self._gen_prompt(leg, k),
+                n_new=int(leg.params.get("n_new", 4)),
+            )
+            if tokens.size == 0:
+                return "error", "empty generation"
+            return "ok", ""
+        finally:
+            client.close()
+
+    def _datacentric_op(self, leg, k: int) -> tuple[str, str]:
+        from pygrid_tpu.client import DataCentricFLClient
+
+        node = self.topology.nodes[k % len(self.topology.nodes)]
+        tag = f"#storm-{k % 5}"
+        client = DataCentricFLClient(node.url)
+        try:
+            ptr = client.send(
+                np.arange(4, dtype=np.float32) + k, tags=(tag,)
+            )
+            found = client.search(tag)
+            if not found:
+                return "error", "sent tensor not discoverable"
+            got = np.asarray(ptr.get())  # fetch-and-delete round trip
+            if got.shape != (4,):
+                return "error", f"bad pointer round trip: {got.shape}"
+            return "ok", ""
+        finally:
+            client.close()
+
+    def _smpc_op(self, leg, k: int) -> tuple[str, str]:
+        from pygrid_tpu.client import DataCentricFLClient
+        from pygrid_tpu.smpc import fix_prec_share_to_nodes
+
+        if len(self.topology.nodes) < 2:
+            return "rejected", "smpc leg needs >= 2 nodes"
+        clients = [
+            DataCentricFLClient(n.url) for n in self.topology.nodes[:2]
+        ]
+        try:
+            x = np.array([float(k), 2.5])
+            y = np.array([1.0, -0.5])
+            sx = fix_prec_share_to_nodes(x, clients)
+            sy = fix_prec_share_to_nodes(y, clients)
+            got = np.asarray((sx + sy).get())
+            if not np.allclose(got, x + y, atol=1e-3):
+                return "error", f"smpc reconstruction off: {got}"
+            return "ok", ""
+        finally:
+            for c in clients:
+                c.close()
+
+
+def _classify_error(err: Exception) -> tuple[str, str]:
+    msg = str(err)
+    low = msg.lower()
+    if "busy" in low or "queue full" in low or "exhausted" in low:
+        return "busy", msg
+    return "error", f"{type(err).__name__}: {msg}"
+
+
+def _classify_fl_error(msg: str) -> tuple[str, str]:
+    """Typed cycle-protocol rejections are expected open-loop outcomes
+    (a report can always race cycle completion); anything else is a
+    real failure."""
+    low = msg.lower()
+    if (
+        "request key" in low
+        or "cycle not found" in low
+        or "already reported" in low
+        or "no process" in low
+    ):
+        return "stale", msg
+    return "error", msg
+
+
+# ── watcher ─────────────────────────────────────────────────────────────
+
+
+class ReactionWatcher:
+    """Samples the system's *reaction surface* at monitor cadence: node
+    SLO statuses (driving ``evaluate`` so transitions are detected even
+    when nobody scrapes), network proxy statuses, and live placement.
+    The timeline is what the reaction assertions read."""
+
+    def __init__(self, topology: StormTopology, t0: float,
+                 interval_s: float) -> None:
+        self.topology = topology
+        self.t0 = t0
+        self.interval_s = interval_s
+        self.timeline: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="storm-watcher", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def samples(self) -> list[dict]:
+        with self._lock:
+            return list(self.timeline)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            sample: dict[str, Any] = {
+                "t_s": time.monotonic() - self.t0,
+            }
+            try:
+                rows = self.topology.node_ctx(0).slo.evaluate()
+                sample["slo"] = {r["name"]: r["status"] for r in rows}
+            except Exception as err:  # noqa: BLE001 — sampled surface
+                sample["slo_error"] = repr(err)
+            try:
+                ctx = self.topology.network_ctx
+                sample["proxies"] = {
+                    node_id: {
+                        "status": proxy.status,
+                        "degraded": proxy.degraded,
+                    }
+                    for node_id, proxy in dict(ctx.proxies).items()
+                }
+                sample["placement"] = [
+                    e.subagg_id for e in ctx.aggregation.live()
+                ]
+            except Exception as err:  # noqa: BLE001 — sampled surface
+                sample["network_error"] = repr(err)
+            with self._lock:
+                self.timeline.append(sample)
+            self._stop.wait(self.interval_s)
+
+
+# ── the harness ─────────────────────────────────────────────────────────
+
+
+@dataclasses.dataclass
+class StormReport:
+    scenario: dict
+    verdicts: list
+    metrics: dict
+    dump_path: str | None
+
+    @property
+    def ok(self) -> bool:
+        return all(v.ok for v in self.verdicts)
+
+
+class StormHarness:
+    """One scenario end to end: env → topology → traffic + faults →
+    reaction assertions → replayable flight dump → teardown. The env
+    and module-level fault state are restored even on failure, so a
+    storm can run inside the tier-1 pytest process without leaking
+    knobs into later tests."""
+
+    def __init__(self, scenario) -> None:
+        self.scenario = scenario.validate()
+
+    def run(self) -> StormReport:
+        from pygrid_tpu.client import ws_transport
+        from pygrid_tpu.storm.assertions import run_checks
+        from pygrid_tpu.storm.faults import FaultInjector
+
+        spec = self.scenario
+        saved_env = {
+            k: os.environ.get(k) for k in spec.env
+        }
+        os.environ.update({k: str(v) for k, v in spec.env.items()})
+        topology = None
+        try:
+            topology = StormTopology(spec).build()
+            recorder.note(
+                "storm.start", scenario=spec.name, seed=spec.seed
+            )
+            t0 = time.monotonic()
+            watcher = ReactionWatcher(
+                topology, t0, interval_s=spec.monitor_interval_s
+            )
+            injector = FaultInjector(topology, spec, t0)
+            traffic = TrafficEngine(topology, t0)
+            watcher.start()
+            injector.start()
+            traffic.start()
+            traffic.join(timeout=spec.duration_s + 60.0)
+            injector.join(timeout=spec.duration_s + 30.0)
+            remaining = t0 + spec.duration_s - time.monotonic()
+            if remaining > 0:
+                time.sleep(remaining)
+            time.sleep(spec.settle_s)  # drain + recovery transitions
+            watcher.stop()
+            verdicts = run_checks(
+                spec, topology, traffic.ops, injector,
+                watcher.samples(),
+            )
+            metrics = self._metrics(traffic.ops, injector, topology)
+            dump_path = recorder.dump(
+                f"storm-{spec.name}",
+                snapshot={
+                    "storm": {
+                        "scenario": spec.to_dict(),
+                        "verdicts": [
+                            dataclasses.asdict(v) for v in verdicts
+                        ],
+                        "metrics": metrics,
+                    }
+                },
+                force=True,
+            )
+            return StormReport(
+                scenario=spec.to_dict(),
+                verdicts=verdicts,
+                metrics=metrics,
+                dump_path=dump_path,
+            )
+        finally:
+            slo_mod.clear_fault()
+            ws_transport.CHAOS_HOOK = None
+            if topology is not None:
+                topology.close()
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    @staticmethod
+    def _metrics(ops, injector, topology) -> dict:
+        by_leg: dict[str, dict[str, int]] = {}
+        for rec in ops:
+            leg = by_leg.setdefault(rec.leg, {})
+            leg[rec.outcome] = leg.get(rec.outcome, 0) + 1
+        return {
+            "ops": by_leg,
+            "faults": [
+                {k: v for k, v in ev.items() if k != "applied_mono"}
+                for ev in injector.events
+            ],
+            "ledger": topology.node_ctx(0).serving.ledger(),
+            "transitions": topology.node_ctx(0).slo.transitions(),
+        }

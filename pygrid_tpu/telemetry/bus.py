@@ -151,6 +151,10 @@ _FAMILY_HELP: dict[str, str] = {
     "slo_webhook_posts_total": (
         "SLO breach-webhook deliveries, by objective and outcome"
     ),
+    "slo_breach_detect_seconds": (
+        "injected-fault to breach-detection latency, by objective "
+        "(only observed when a fault is marked via slo.mark_fault)"
+    ),
     # observability engine (telemetry/{profiler,recorder,slo}.py)
     "profiler_compile_seconds": "jitted-program calls that compiled, by kind",
     "profiler_execute_seconds": "jitted-program steady-state calls, by kind",

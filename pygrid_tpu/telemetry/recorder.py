@@ -52,6 +52,14 @@ MAX_DUMPS = 20
 #: bus ring events embedded in a dump
 DUMP_EVENT_LIMIT = 256
 
+#: flight-dump payload schema version (docs/OBSERVABILITY.md §7). The
+#: dump's top-level shape — reason/ts/error/snapshot/ring/events/stats/
+#: counters — is a STABLE machine-readable contract: replay tooling
+#: (pygrid_tpu/storm/replay.py) and external consumers key on it. Bump
+#: only when an existing key changes shape or meaning; ADDING keys is
+#: compatible and does not bump it.
+SCHEMA_VERSION = 1
+
 #: default seconds between dumps *per reason* (env-overridable)
 DEFAULT_MIN_INTERVAL_S = 30.0
 
@@ -211,6 +219,7 @@ class FlightRecorder:
             # write so a full disk doesn't suppress the next attempt
             self._last_dump[reason] = now
         payload = {
+            "schema_version": SCHEMA_VERSION,
             "reason": reason,
             "ts": time.time(),
             "error": str(error) if error is not None else None,

@@ -62,6 +62,49 @@ DEFAULT_WINDOWS = (300.0, 3600.0)
 #: snapshots retained; at the default 15 s tick this covers > 2 h
 MAX_SNAPSHOTS = 512
 
+#: status transitions retained per engine (reaction-latency reads)
+MAX_TRANSITIONS = 256
+
+
+# ── fault clock ─────────────────────────────────────────────────────────
+#
+# Deliberately injected faults (the storm harness, pygrid_tpu/storm, or
+# an operator's chaos drill) mark their injection time here; when an
+# objective then transitions INTO breach, the engine measures
+# injection→detection as the ``slo_breach_detect_seconds`` histogram —
+# the reaction latency dashboards and storm assertions read. Unmarked
+# production incidents simply never feed the histogram.
+
+_fault_lock = threading.Lock()
+_fault_marks: dict[str, float] = {}
+
+
+def mark_fault(label: str = "fault", ts: float | None = None) -> float:
+    """Record a deliberate fault's injection time (monotonic clock);
+    returns the recorded timestamp. Re-marking a label overwrites it."""
+    ts = ts if ts is not None else time.monotonic()
+    with _fault_lock:
+        _fault_marks[label] = ts
+    return ts
+
+
+def clear_fault(label: str | None = None) -> None:
+    """Forget one fault mark (or all of them): the fault was cleared,
+    so later breaches are not attributed to it."""
+    with _fault_lock:
+        if label is None:
+            _fault_marks.clear()
+        else:
+            _fault_marks.pop(label, None)
+
+
+def last_fault_ts() -> float | None:
+    """The newest outstanding fault mark, or None when nothing is
+    marked — breach transitions only measure detection latency against
+    a fault that is actually standing."""
+    with _fault_lock:
+        return max(_fault_marks.values()) if _fault_marks else None
+
 
 @dataclass(frozen=True)
 class Objective:
@@ -341,6 +384,12 @@ class SLOEngine:
         #: the previous snapshot instead, so the ring always spans at
         #: least ~2× the longest window.
         self._min_gap_s = max(self.windows) / (MAX_SNAPSHOTS // 2)
+        #: last status seen per objective + the transition log the storm
+        #: harness reads to time reactions ("when did ttft flip to
+        #: breach?") — webhook delivery state lives in the notifier and
+        #: has retry semantics; this log records every flip exactly once
+        self._status_seen: dict[str, str] = {}
+        self._transitions: deque[dict] = deque(maxlen=MAX_TRANSITIONS)
 
     # ── collection ──────────────────────────────────────────────────────
 
@@ -439,6 +488,7 @@ class SLOEngine:
                 window_counts[label] = delta[0]
                 burns[label] = self._burn(delta, obj.budget)
             status = self._status(obj, compliance, burns, window_counts)
+            self._note_transition(obj, status, now)
             row = {
                 "name": obj.name,
                 "family": obj.family,
@@ -458,6 +508,38 @@ class SLOEngine:
         except Exception:  # noqa: BLE001 — alerting must not break reads
             logger.exception("SLO webhook notifier failed")
         return out
+
+    def _note_transition(self, obj: Objective, status: str, now: float) -> None:
+        """Log a status flip, and when an objective flips INTO breach
+        while a deliberate fault is marked, observe injection→detection
+        as ``slo_breach_detect_seconds`` (the reaction-latency metric)."""
+        with self._lock:
+            prev = self._status_seen.get(obj.name)
+            if status == prev:
+                return
+            self._status_seen[obj.name] = status
+            self._transitions.append(
+                {
+                    "name": obj.name,
+                    "from": prev,
+                    "to": status,
+                    "ts": now,
+                    "wall_ts": time.time(),
+                }
+            )
+        if status == "breach" and prev != "breach":
+            fault_ts = last_fault_ts()
+            if fault_ts is not None and now >= fault_ts:
+                self._source.observe(
+                    "slo_breach_detect_seconds",
+                    now - fault_ts,
+                    objective=obj.name,
+                )
+
+    def transitions(self) -> list[dict]:
+        """Status flips, oldest first (bounded by MAX_TRANSITIONS)."""
+        with self._lock:
+            return list(self._transitions)
 
     def _status(
         self,

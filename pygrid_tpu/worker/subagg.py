@@ -78,6 +78,19 @@ _INELIGIBLE_MARKERS = (
     "needs masked partials",
 )
 
+#: gridlint entry-point annotation (docs/ANALYSIS.md, GL604): this WS
+#: server is not an aiohttp route module, so the boundary heuristics
+#: can't find its handlers on their own. Every name listed here is
+#: treated as a protocol boundary — an untyped exception escaping one
+#: is a GL604 finding, exactly as for a node route. ``_dispatch`` is the
+#: catch-all protocol edge; the two handlers are where report payloads
+#: first meet untrusted input.
+GRIDLINT_ENTRY_POINTS = (
+    "SubAggregator.handle_report",
+    "SubAggregator.handle_partial",
+    "_dispatch",
+)
+
 
 class _FoldSlot:
     """One fold key's live accumulation. The slot lock serializes the
@@ -158,7 +171,12 @@ class SubAggregator:
         if isinstance(diff, str):
             from pygrid_tpu.native import b64_decode_view
 
-            diff = b64_decode_view(diff)
+            try:
+                diff = b64_decode_view(diff)
+            except ValueError as err:
+                raise E.PyGridError(
+                    f"malformed report diff: {err}"
+                ) from err
         elif not isinstance(diff, bytes):
             diff = bytes(diff)
         worker_id = data.get(MSG_FIELD.WORKER_ID)
@@ -179,7 +197,12 @@ class SubAggregator:
             # partial before acking, so a report is never folded into
             # a sum the node will refuse
             probe = PartialFold()
-            probe.add_report(worker_id, request_key, bytes(diff))
+            try:
+                probe.add_report(worker_id, request_key, bytes(diff))
+            except ValueError as err:
+                raise E.PyGridError(
+                    f"malformed report payload: {err}"
+                ) from err
             self._probe(key, probe)
             with self._lock:
                 self._reports += 1
@@ -226,10 +249,17 @@ class SubAggregator:
             # partial, and an incompatible process would then eat the
             # whole subtree silently at this tier's flush
             probe = PartialFold()
-            probe.add_partial(
-                entries, bytes(diff), count,
-                weight_sum=weight_sum, masked=masked,
-            )
+            try:
+                probe.add_partial(
+                    entries, bytes(diff), count,
+                    weight_sum=weight_sum, masked=masked,
+                )
+            except ValueError as err:
+                # malformed payloads (bad base64, size-mismatched bf16
+                # accumulation) must bounce TYPED at the boundary
+                raise E.PyGridError(
+                    f"malformed partial payload: {err}"
+                ) from err
             self._probe(key, probe)
             with self._lock:
                 self._reports += 1
@@ -260,7 +290,15 @@ class SubAggregator:
             with slot.lock:
                 if slot.closed:
                     continue  # lost the race with a flush — fresh slot
-                add(slot.fold)
+                try:
+                    add(slot.fold)
+                except ValueError as err:
+                    # the fold validates payload shape as it
+                    # accumulates — a malformed diff bounces typed,
+                    # slot untouched
+                    raise E.PyGridError(
+                        f"malformed report payload: {err}"
+                    ) from err
                 if slot.fold.count >= self.fanout:
                     slot.closed = True
                     ready = slot.fold
@@ -413,6 +451,13 @@ class SubAggregator:
             "buffered": buffered,
             "fanout": self.fanout,
         }
+
+    def sever_upstream(self) -> None:
+        """FAULT INJECTION (pygrid_tpu/storm): drop the upstream WS
+        connection as if the node-side link died mid-cycle. The next
+        flush exercises the real reconnect path — this kills the socket,
+        not the client, so no production code is bypassed."""
+        self._upstream._drop_connection()
 
     def close(self) -> None:
         self.flush_all()

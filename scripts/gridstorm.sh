@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# gridstorm — open-loop load + fault-injection storms (docs/STORM.md).
+# Drives a real in-process node+network+subagg topology on the CPU
+# twin, injects the scenario's fault schedule, and exits non-zero if
+# any reaction assertion fails. Tier-1 runs the smoke scenario
+# in-process via tests/integration/test_storm_smoke.py.
+#
+#   scripts/gridstorm.sh                  # the full acceptance storm
+#   scripts/gridstorm.sh --smoke          # tier-1 smoke storm (≤30 s)
+#   scripts/gridstorm.sh --scenario NAME  # any built-in (--list)
+#   scripts/gridstorm.sh --spec file.yaml # declarative scenario spec
+#   scripts/gridstorm.sh --replay DUMP    # replay a flight dump as a
+#                                         # regression scenario
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# storms are CPU-twin affairs: pin the platform so an attached
+# accelerator never changes the breach math a scenario was tuned for
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+if [ "${1:-}" = "--smoke" ]; then
+  shift
+  exec python -m pygrid_tpu.storm --scenario smoke "$@"
+fi
+if [ $# -gt 0 ]; then
+  exec python -m pygrid_tpu.storm "$@"
+fi
+exec python -m pygrid_tpu.storm --scenario full

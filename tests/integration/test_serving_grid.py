@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import base64
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -92,6 +93,23 @@ def test_concurrent_ws_clients_match_sequential_path(grid, hosted):
             decode.generate(params, prompt.astype(np.int32), n_new, CFG)
         )
         np.testing.assert_array_equal(got, expect)
+    # the public leak ledger (ServingManager.ledger): once responses
+    # land the engine may still be retiring its last slot, so allow a
+    # short drain — then all block accounting must balance, with
+    # nothing stuck in queues or slots
+    serving = grid.nodes["dan"].app["node"].serving
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        ledger = serving.ledger()
+        if ledger["balanced"] and all(
+            led["queue_depth"] == 0 and led["live_slots"] == 0
+            for led in ledger["engines"]
+        ):
+            break
+        time.sleep(0.05)
+    assert ledger["balanced"], ledger
+    for led in ledger["engines"]:
+        assert led["queue_depth"] == 0 and led["live_slots"] == 0, led
 
 
 def test_http_route_serves_and_is_typed(grid, hosted):

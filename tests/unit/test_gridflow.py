@@ -479,6 +479,46 @@ class TestGL604:
         })
         assert _codes(res) == ["GL604"]
 
+    def test_annotated_entry_point_fires(self, tmp_path):
+        """A module the pattern scan can't see (no aiohttp routes, no
+        handler table) declares its boundary handlers with a
+        module-level ``GRIDLINT_ENTRY_POINTS`` tuple — the annotation
+        makes an untyped escape a GL604 finding. This is how
+        worker/subagg.py's embedded-server dispatch enters the rule."""
+        res = _lint(tmp_path, {
+            "pkg/worker/sub.py": """
+                GRIDLINT_ENTRY_POINTS = ("Sub.handle_report", "_dispatch")
+
+                class Sub:
+                    def handle_report(self, msg):
+                        raise KeyError(msg["id"])
+
+                def _dispatch(raw):
+                    raise ValueError("bad frame")
+            """,
+        })
+        assert _codes(res) == ["GL604", "GL604"]
+
+    def test_annotated_entry_point_typed_raise_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, {
+            "pkg/worker/sub.py": """
+                from pkg.errors import BadFrameError
+
+                GRIDLINT_ENTRY_POINTS = ("_dispatch",)
+
+                def _dispatch(raw):
+                    raise BadFrameError("bad frame")
+            """,
+            "pkg/errors.py": """
+                class PyGridError(Exception):
+                    pass
+
+                class BadFrameError(PyGridError):
+                    pass
+            """,
+        })
+        assert _codes(res) == []
+
     def test_catch_of_base_class_covers_subclass_raise(self, tmp_path):
         """``except LookupError`` covers a KeyError raise (builtin
         hierarchy), and ``except Exception`` covers everything."""

@@ -1,0 +1,261 @@
+"""gridstorm's deterministic plumbing (PR 12): scenario specs as the
+replay contract, seeded arrival schedules, the SLO fault clock that
+turns breach transitions into ``slo_breach_detect_seconds`` samples,
+the leak-ledger snapshot invariants, and flight-dump validation. The
+end-to-end storm itself runs in tests/integration/test_storm_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pygrid_tpu import telemetry
+from pygrid_tpu.network.aggregation import AggregationRegistry
+from pygrid_tpu.serving.pagedkv import BlockPool
+from pygrid_tpu.storm import replay as replay_mod
+from pygrid_tpu.storm.loadgen import arrival_times
+from pygrid_tpu.storm.scenarios import (
+    FaultSpec,
+    StormScenario,
+    TrafficSpec,
+    builtin_scenarios,
+    get_scenario,
+)
+from pygrid_tpu.telemetry import recorder
+from pygrid_tpu.telemetry import slo as slo_mod
+from pygrid_tpu.telemetry.bus import TelemetryBus
+from pygrid_tpu.telemetry.slo import Objective, SLOEngine
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYGRID_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("PYGRID_FLIGHT_MIN_INTERVAL_S", "0")
+    telemetry.reset()
+    recorder.reset()
+    slo_mod.clear_fault()
+    yield
+    telemetry.reset()
+    recorder.reset()
+    slo_mod.clear_fault()
+
+
+# ── scenarios: the replay contract ──────────────────────────────────────
+
+
+class TestScenarioSpec:
+    def test_builtins_validate_and_round_trip(self):
+        for name in builtin_scenarios():
+            spec = get_scenario(name)
+            clone = StormScenario.from_dict(spec.to_dict())
+            assert clone.to_dict() == spec.to_dict()
+
+    def test_dict_round_trip_is_json_safe(self):
+        # the dump embeds the dict via json — no dataclass leakage
+        d = get_scenario("smoke").to_dict()
+        assert json.loads(json.dumps(d)) == d
+
+    def test_yaml_round_trip(self):
+        yaml = pytest.importorskip("yaml")
+        spec = get_scenario("smoke")
+        clone = StormScenario.from_yaml(yaml.safe_dump(spec.to_dict()))
+        assert clone.to_dict() == spec.to_dict()
+
+    def test_unknown_leg_fault_check_and_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown traffic leg"):
+            StormScenario(
+                name="x", duration_s=1.0,
+                traffic=[TrafficSpec(leg="carrier-pigeon", rate_hz=1.0)],
+            ).validate()
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            StormScenario(
+                name="x", duration_s=1.0,
+                faults=[FaultSpec(kind="meteor", at_s=0.5)],
+            ).validate()
+        with pytest.raises(ValueError, match="unknown check"):
+            StormScenario(
+                name="x", duration_s=1.0, checks=["vibes"],
+            ).validate()
+        with pytest.raises(ValueError, match="unknown scenario fields"):
+            StormScenario.from_dict(
+                {"name": "x", "duration_s": 1.0, "cadence": 3}
+            )
+
+    def test_fault_outside_clock_and_impossible_kill_rejected(self):
+        with pytest.raises(ValueError, match="outside the scenario"):
+            StormScenario(
+                name="x", duration_s=1.0,
+                faults=[FaultSpec(kind="slow_node", at_s=5.0)],
+            ).validate()
+        with pytest.raises(ValueError, match="needs at least one"):
+            StormScenario(
+                name="x", duration_s=1.0, subaggs=0,
+                faults=[FaultSpec(kind="kill_subagg", at_s=0.1)],
+            ).validate()
+
+
+class TestArrivalSchedule:
+    def test_deterministic_across_calls(self):
+        a = arrival_times(7, 2, 5.0, 0.0, 30.0)
+        b = arrival_times(7, 2, 5.0, 0.0, 30.0)
+        assert a == b and len(a) > 0
+
+    def test_seed_and_leg_decorrelate(self):
+        assert arrival_times(7, 0, 5.0, 0.0, 30.0) != arrival_times(
+            8, 0, 5.0, 0.0, 30.0
+        )
+        assert arrival_times(7, 0, 5.0, 0.0, 30.0) != arrival_times(
+            7, 1, 5.0, 0.0, 30.0
+        )
+
+    def test_rate_and_bounds(self):
+        times = arrival_times(7, 0, 10.0, 2.0, 32.0)
+        assert all(2.0 < t < 32.0 for t in times)
+        assert times == sorted(times)
+        # 300 expected arrivals: a 3× deviation means broken seeding
+        assert 100 < len(times) < 900
+
+
+# ── slo fault clock → transitions → reaction histogram ──────────────────
+
+
+class TestFaultClock:
+    def _engine(self, bus):
+        return SLOEngine(
+            [Objective("lat", "lat_seconds", threshold_s=0.01,
+                       target=0.99)],
+            windows=(60.0, 600.0),
+            source=bus,
+        )
+
+    def test_breach_after_mark_observes_detect_latency(self):
+        bus = TelemetryBus()
+        eng = self._engine(bus)
+        eng.evaluate(now=0.0)
+        slo_mod.mark_fault("chaos", ts=5.0)
+        for _ in range(50):
+            bus.observe("lat_seconds", 5.0)
+        eng.evaluate(now=12.0)
+        flips = [t for t in eng.transitions() if t["to"] == "breach"]
+        assert [t["name"] for t in flips] == ["lat"]
+        snaps = {
+            name: snap
+            for (name, _labels), snap in bus.histograms().items()
+            if name == "slo_breach_detect_seconds"
+        }
+        (snap,) = snaps.values()
+        assert snap["count"] == 1
+        # detected at now=12 against the fault marked at 5 → 7s
+        assert 6.9 <= snap["sum"] <= 7.1
+
+    def test_no_mark_no_sample(self):
+        bus = TelemetryBus()
+        eng = self._engine(bus)
+        eng.evaluate(now=0.0)
+        for _ in range(50):
+            bus.observe("lat_seconds", 5.0)
+        eng.evaluate(now=12.0)
+        assert any(t["to"] == "breach" for t in eng.transitions())
+        assert not any(
+            name == "slo_breach_detect_seconds"
+            for (name, _labels) in bus.histograms()
+        )
+
+    def test_staying_in_breach_samples_once(self):
+        bus = TelemetryBus()
+        eng = self._engine(bus)
+        eng.evaluate(now=0.0)
+        slo_mod.mark_fault("chaos", ts=1.0)
+        for _ in range(50):
+            bus.observe("lat_seconds", 5.0)
+        eng.evaluate(now=12.0)
+        eng.evaluate(now=13.0)  # still in breach — no new edge
+        (snap,) = (
+            snap for (name, _l), snap in bus.histograms().items()
+            if name == "slo_breach_detect_seconds"
+        )
+        assert snap["count"] == 1
+
+    def test_transitions_log_orders_and_bounds(self):
+        bus = TelemetryBus()
+        eng = self._engine(bus)
+        eng.evaluate(now=0.0)
+        for _ in range(50):
+            bus.observe("lat_seconds", 5.0)
+        eng.evaluate(now=12.0)
+        for _ in range(500):
+            bus.observe("lat_seconds", 0.001)
+        eng.tick(now=3620.0)
+        eng.evaluate(now=3650.0)
+        log = eng.transitions()
+        assert [t["ts"] for t in log] == sorted(t["ts"] for t in log)
+        assert log[0]["from"] is None
+        tos = [t["to"] for t in log if t["name"] == "lat"]
+        assert "breach" in tos and tos[-1] != "breach"
+        assert len(log) <= slo_mod.MAX_TRANSITIONS
+
+    def test_mark_clear_and_last(self):
+        assert slo_mod.last_fault_ts() is None
+        slo_mod.mark_fault("a", ts=3.0)
+        slo_mod.mark_fault("b", ts=9.0)
+        assert slo_mod.last_fault_ts() == 9.0
+        slo_mod.clear_fault("b")
+        assert slo_mod.last_fault_ts() == 3.0
+        slo_mod.clear_fault()
+        assert slo_mod.last_fault_ts() is None
+
+
+# ── leak ledgers ────────────────────────────────────────────────────────
+
+
+class TestLedgers:
+    def test_block_pool_ledger_balances_through_churn(self):
+        pool = BlockPool(16)
+        led = pool.ledger()
+        # block 0 is the trash block — usable is num_blocks - 1
+        assert led["free"] == led["usable"] == 15 and led["balanced"]
+        blocks = pool.alloc(5)
+        led = pool.ledger()
+        assert led["held"] == 5 and led["free"] == 10 and led["balanced"]
+        pool.release(blocks[:2])
+        led = pool.ledger()
+        assert led["held"] == 3 and led["free"] == 12 and led["balanced"]
+        pool.retire(2)
+        led = pool.ledger()
+        assert led["retired"] == 2 and led["usable"] == 13
+        assert led["balanced"]
+
+    def test_expire_backdates_heartbeat(self):
+        reg = AggregationRegistry(ttl_s=30.0)
+        reg.register("sub-1", "ws://x", "ws://node")
+        assert [e.subagg_id for e in reg.live()] == ["sub-1"]
+        assert reg.expire("sub-1") is True
+        assert reg.live() == []
+        assert reg.expire("no-such") is False
+
+
+# ── flight-dump validation (the replay gate) ────────────────────────────
+
+
+class TestReplayValidation:
+    def test_dump_round_trips_schema_version(self):
+        path = recorder.dump("unit-roundtrip", force=True)
+        payload = json.loads(open(path, encoding="utf-8").read())
+        assert payload["schema_version"] == recorder.SCHEMA_VERSION
+        # the version key leads the record so forensics can gate on it
+        # before parsing the rest
+        first = open(path, encoding="utf-8").read(40)
+        assert '"schema_version"' in first
+
+    def test_load_dump_rejects_wrong_schema(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"schema_version": 99}))
+        with pytest.raises(replay_mod.ReplayError, match="schema_version"):
+            replay_mod.load_dump(str(bad))
+
+    def test_load_dump_rejects_non_storm_record(self):
+        path = recorder.dump("unit-nonstorm", force=True)
+        with pytest.raises(replay_mod.ReplayError, match="no storm"):
+            replay_mod.load_dump(path)
