@@ -12,6 +12,7 @@ from pygrid_tpu.analysis.checkers.gl3_async import AsyncHygieneChecker
 from pygrid_tpu.analysis.checkers.gl4_contracts import ContractDriftChecker
 from pygrid_tpu.analysis.checkers.gl5_pallas import PallasBoundsChecker
 from pygrid_tpu.analysis.checkers.gl6_flow import DataFlowChecker
+from pygrid_tpu.analysis.checkers.gl7_proto import ProtocolChecker
 
 #: two classes share the GL2 family: the per-class lock rules
 #: (GL201–203) and the whole-program concurrency pass (GL204–206) —
@@ -24,6 +25,7 @@ ALL_CHECKERS = (
     ContractDriftChecker,
     PallasBoundsChecker,
     DataFlowChecker,
+    ProtocolChecker,
 )
 
 __all__ = [
@@ -34,5 +36,6 @@ __all__ = [
     "DataFlowChecker",
     "LockDisciplineChecker",
     "PallasBoundsChecker",
+    "ProtocolChecker",
     "TraceSafetyChecker",
 ]

@@ -20,8 +20,10 @@ Rides :mod:`pygrid_tpu.analysis.flow` over the shared
   innocent key is exactly the leak class this rule exists for.
 - **GL603** resource acquire/release pairing: a ``BlockPool.alloc``,
   socket, temp file, or non-``with`` lock ``.acquire()`` must balance
-  on every explicit path out of the acquiring function — returns,
-  explicit raises, fall-through — unless the resource escapes
+  on every path out of the acquiring function — returns, explicit
+  raises, fall-through, and implicit raises (a resolved callee whose
+  untyped-exception escape set is uncovered at the call site, via the
+  same ExceptionFlow model GL604 uses) — unless the resource escapes
   (stored, returned, handed to a callee: ownership transferred).
   ``try/finally`` and the repo's cleanup idioms (``close``/``release``
   /``retire``/``free``/``unlink``/``_fail_all``) are recognized;
@@ -106,8 +108,10 @@ class DataFlowChecker(Checker):
             # non-credential taint into egress (payload → wire frame)
             # is the protocol working as designed — quiet
 
-        # ── GL603: resource pairing ───────────────────────────────────
-        for fn, node, kind, why in resource_findings(graph):
+        # ── GL603: resource pairing (shares GL604's exception-escape
+        # model so implicit raises out of callees count as exits) ─────
+        escapes = ExceptionFlow(graph)
+        for fn, node, kind, why in resource_findings(graph, escapes):
             mod = mods.get(fn.rel_path)
             if mod is None:
                 continue
@@ -121,7 +125,6 @@ class DataFlowChecker(Checker):
             )
 
         # ── GL604: untyped-exception escape ───────────────────────────
-        escapes = ExceptionFlow(graph)
         entries = boundary_entry_points(graph)
         reported: set[tuple] = set()
         for entry_key, desc in sorted(entries.items()):

@@ -93,15 +93,24 @@ def _list_checkers() -> str:
 
 def _git_changed_files(root: str) -> set[str] | None:
     """Repo-relative POSIX paths of changed .py files: ``git diff
-    --name-only HEAD`` (staged + unstaged) plus untracked. None when
-    git is unavailable (not a repo, no binary) — callers treat that as
-    a usage error, not an empty change set."""
+    --name-only HEAD`` (staged + unstaged) plus untracked. With
+    ``GRIDLINT_BASE`` set (CI: the PR's base ref), the diff is taken
+    against ``<base>...HEAD`` instead, so a PR job lints every commit
+    on the branch, not just the dirty tree. None when git is
+    unavailable (not a repo, no binary) — callers treat that as a
+    usage error, not an empty change set."""
     import os
     import subprocess
 
+    base = os.environ.get("GRIDLINT_BASE", "").strip()
+    diff_cmd = (
+        ["git", "diff", "--name-only", f"{base}...HEAD"]
+        if base
+        else ["git", "diff", "--name-only", "HEAD"]
+    )
     out: set[str] = set()
     for cmd in (
-        ["git", "diff", "--name-only", "HEAD"],
+        diff_cmd,
         ["git", "ls-files", "--others", "--exclude-standard"],
     ):
         try:

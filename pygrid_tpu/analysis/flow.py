@@ -217,12 +217,21 @@ class Summary:
     source_returns: dict = field(default_factory=dict)
     #: (param, sink site, kind) -> SinkFlow
     param_sinks: dict = field(default_factory=dict)
+    #: param -> {((rel, class), attr)} — fields the param is stored to
+    #: (``self._x = param``); callers replay their concrete taints onto
+    #: the class-attr map (field-sensitive param summaries)
+    param_to_fields: dict = field(default_factory=dict)
 
     def shape(self) -> tuple:
         return (
             frozenset(self.param_to_return),
             frozenset(self.source_returns),
             frozenset(self.param_sinks),
+            frozenset(
+                (p, f)
+                for p, fields in self.param_to_fields.items()
+                for f in fields
+            ),
         )
 
 
@@ -367,14 +376,21 @@ class _FnFlow:
     def _store_attr(self, target: ast.Attribute, taints: TaintSet) -> None:
         """``self._x = tainted``: record on the class-attr map so every
         method's reads observe it (flow-insensitive field taint).
-        Symbolic param taints are dropped here — field-sensitive param
-        summaries are beyond lint-grade need."""
+        Symbolic param taints become ``param_to_fields`` summary entries
+        — each caller replays its own concrete argument taints onto the
+        field (field-sensitive param summaries)."""
         if not (
             isinstance(target.value, ast.Name)
             and target.value.id in ("self", "cls")
             and self.fn.class_name is not None
         ):
             return
+        key = ((self.fn.rel_path, self.fn.class_name), target.attr)
+        for t in taints.values():
+            if t.param is not None:
+                self.summary.param_to_fields.setdefault(
+                    t.param, set()
+                ).add(key)
         concrete = {
             k: t.extend(
                 f"stored to self.{target.attr} in {self.fn.pretty}"
@@ -384,7 +400,6 @@ class _FnFlow:
         }
         if not concrete:
             return
-        key = ((self.fn.rel_path, self.fn.class_name), target.attr)
         store = self.engine.attr_taints.setdefault(key, {})
         before = len(store)
         for k, t in concrete.items():
@@ -683,6 +698,26 @@ class _FnFlow:
                                     chain=t.chain + (step,) + flow.chain,
                                 )
                             )
+                for fkey in summary.param_to_fields.get(pname, ()):
+                    # the callee stores this param to a field: replay
+                    # OUR concrete taints onto the class-attr map, and
+                    # carry symbolic ones up as our own field summary
+                    for t in taints.values():
+                        if t.param is not None:
+                            self.summary.param_to_fields.setdefault(
+                                t.param, set()
+                            ).add(fkey)
+                        elif t.tag is not None:
+                            e = t.extend(
+                                f"stored to {fkey[0][1]}.{fkey[1]} "
+                                f"via {callee.pretty}() at {loc}"
+                            )
+                            store = self.engine.attr_taints.setdefault(
+                                fkey, {}
+                            )
+                            if e.key not in store:
+                                store[e.key] = e
+                                self.engine.attrs_changed = True
             for t in summary.source_returns.values():
                 e = t.extend(f"returned to {self.fn.pretty} at {loc}")
                 result.setdefault(e.key, e)
@@ -900,13 +935,25 @@ def _acquire_of(value: ast.AST) -> str | None:
 
 
 class _ResourceWalk:
-    """Intra-procedural path walk for acquire/release pairing. Explicit
-    exits only (returns, explicit raises, fall-through) — implicit
-    exception propagation out of an arbitrary call is not modeled, so
-    the rule errs quiet on branchy code rather than flooding."""
+    """Intra-procedural path walk for acquire/release pairing. Exits
+    modeled: returns, explicit raises, fall-through — and, when an
+    :class:`ExceptionFlow` is supplied, IMPLICIT raises: a statement
+    calling a resolved callee whose untyped-exception escape set is not
+    covered by an enclosing ``try`` can blow through the frame, so an
+    open unprotected resource leaks there too. Unresolvable callees err
+    quiet rather than flooding."""
 
-    def __init__(self, fn: FunctionNode) -> None:
+    def __init__(
+        self, fn: FunctionNode, exc_flow: "ExceptionFlow | None" = None
+    ) -> None:
         self.fn = fn
+        self.exc_flow = exc_flow
+        #: (lineno, col) -> resolved callee keys, from the graph's call
+        #: edges — drives the implicit-raise check
+        self._call_targets = {
+            (c.node.lineno, c.node.col_offset): c.targets
+            for c in fn.calls
+        }
         self.findings: list[tuple[ast.AST, str, str]] = []  # node, kind, why
         self._counter = 0
         #: resource keys already reported — clones share keys, so a
@@ -914,6 +961,60 @@ class _ResourceWalk:
         #: join's merge re-opens the resource for the OTHER path (one
         #: report per acquire keeps baseline allowances stable)
         self._reported: set[int] = set()
+
+    # ── implicit exception propagation ──────────────────────────────────
+
+    def _implicit_raise_via(self, stmt: ast.stmt) -> str | None:
+        """A callee in ``stmt`` whose uncovered untyped escape can blow
+        through this frame, or None. Catch coverage at the call site is
+        the same enclosing-try model GL604 uses."""
+        if self.exc_flow is None:
+            return None
+        graph = self.exc_flow.graph
+        covers = self.exc_flow._covers.get(self.fn.key, {})
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            targets = self._call_targets.get(
+                (node.lineno, node.col_offset)
+            )
+            if not targets:
+                continue
+            active = covers.get((node.lineno, node.col_offset), [])
+            for target in targets:
+                callee = graph.functions.get(target)
+                if callee is None:
+                    continue
+                if callee.is_async and not self.fn.is_async:
+                    continue  # only scheduled here, raises at the await
+                for exc in self.exc_flow.escapes.get(target, ()):
+                    if not self.exc_flow._covered(
+                        exc, active, self.fn.rel_path
+                    ):
+                        return f"{callee.qualname}() (raises {exc})"
+        return None
+
+    def _implicit_leaks(
+        self, stmt: ast.stmt, state: dict, protected: frozenset
+    ) -> None:
+        via = self._implicit_raise_via(stmt)
+        if via is None:
+            return
+        for key, res in state.items():
+            if res.open and not res.escaped and not (
+                set(res.names) & protected
+            ) and key not in self._reported:
+                self._reported.add(key)
+                self.findings.append(
+                    (
+                        res.node,
+                        res.kind,
+                        f"leaks when {via} propagates through this "
+                        "frame (implicit exception path, no try/finally "
+                        "release)",
+                    )
+                )
+                res.open = False
 
     def run(self) -> list[tuple[ast.AST, str, str]]:
         state: dict[int, _Resource] = {}
@@ -1077,6 +1178,7 @@ class _ResourceWalk:
                         len(res.names) == 1
                     ):
                         res.escaped = True  # err quiet: aliased away
+            self._implicit_leaks(stmt, state, protected)
             return
         if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
             call = stmt.value
@@ -1089,6 +1191,7 @@ class _ResourceWalk:
             for a in list(call.args) + [kw.value for kw in call.keywords]:
                 names |= self._names_in(a)
             self._apply_escapes(state, names)
+            self._implicit_leaks(stmt, state, protected)
             # bare ``x.acquire()`` statement: a non-with lock acquire
             d = dotted(call.func)
             if (
@@ -1194,9 +1297,11 @@ class _ResourceWalk:
 
 def resource_findings(
     graph: ProgramGraph,
+    exception_flow: "ExceptionFlow | None" = None,
 ) -> Iterable[tuple[FunctionNode, ast.AST, str, str]]:
     """GL603 raw findings: ``(fn, node, kind, why)`` per unbalanced
-    acquire."""
+    acquire. With ``exception_flow``, implicit raises out of resolved
+    callees are modeled as exits too."""
     for fn in graph.functions.values():
         # cheap pre-filter: only walk bodies that acquire at all
         has_acquire = False
@@ -1213,7 +1318,7 @@ def resource_findings(
                 break
         if not has_acquire:
             continue
-        for node, kind, why in _ResourceWalk(fn).run():
+        for node, kind, why in _ResourceWalk(fn, exception_flow).run():
             yield fn, node, kind, why
 
 

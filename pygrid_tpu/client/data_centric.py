@@ -22,7 +22,7 @@ from pygrid_tpu.runtime import messages as M
 from pygrid_tpu.runtime.pointers import PointerTensor, _raise_if_error
 from pygrid_tpu.runtime.pointers import send as _send
 from pygrid_tpu.serde import deserialize, serialize
-from pygrid_tpu.utils.codes import MSG_FIELD, REQUEST_MSG
+from pygrid_tpu.utils.codes import CONTROL_EVENTS, MSG_FIELD, REQUEST_MSG
 from pygrid_tpu.utils.exceptions import PyGridError
 
 
@@ -73,7 +73,10 @@ class DataCentricFLClient:
 
     def ping(self) -> bool:
         return (
-            self.ws.send_json("socket-ping").get(MSG_FIELD.ALIVE) == "True"
+            self.ws.send_json(CONTROL_EVENTS.SOCKET_PING).get(
+                MSG_FIELD.ALIVE
+            )
+            == "True"
         )
 
     def close(self) -> None:

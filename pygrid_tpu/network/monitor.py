@@ -17,6 +17,7 @@ import time
 from typing import Any
 
 from pygrid_tpu import telemetry
+from pygrid_tpu.utils.codes import NODE_EVENTS
 
 logger = logging.getLogger(__name__)
 
@@ -145,7 +146,7 @@ async def monitor_loop(ctx) -> None:
                     try:
                         proxy.monitor_sent()
                         await proxy.socket.send_str(
-                            json.dumps({"type": "monitor"})
+                            json.dumps({"type": NODE_EVENTS.MONITOR})
                         )
                     except Exception:  # noqa: BLE001
                         proxy.mark_offline()

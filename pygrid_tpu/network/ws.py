@@ -16,6 +16,7 @@ import logging
 from aiohttp import WSMsgType, web
 
 from pygrid_tpu.network import NetworkContext
+from pygrid_tpu.utils.codes import NODE_EVENTS
 
 logger = logging.getLogger(__name__)
 
@@ -31,7 +32,7 @@ async def _handle(ctx: NetworkContext, message: dict, ws) -> dict | None:
     if msg_type in USER_HANDLERS:
         return USER_HANDLERS[msg_type](ctx, message)
 
-    if msg_type == "join":
+    if msg_type == NODE_EVENTS.JOIN:
         node_id = data.get("node-id") or data.get("id")
         address = data.get("node-address") or data.get("address")
         ctx.manager.register_new_node(node_id, address)
@@ -40,14 +41,14 @@ async def _handle(ctx: NetworkContext, message: dict, ws) -> dict | None:
         proxy.ping = 0.0
         return {"status": "Successfully Connected!", "id": node_id}
 
-    if msg_type == "monitor-answer":
+    if msg_type == NODE_EVENTS.MONITOR_ANSWER:
         node_id = data.get("id")
         proxy = ctx.proxies.get(node_id)
         if proxy is not None:
             proxy.update_from_answer(data)
         return None
 
-    if msg_type == "forward":
+    if msg_type == NODE_EVENTS.FORWARD:
         dest = data.get("destination")
         proxy = ctx.proxies.get(dest)
         if proxy is None or proxy.socket is None:

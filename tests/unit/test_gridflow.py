@@ -109,6 +109,47 @@ class TestPropagation:
         assert any("stored to self._last" in s
                    for s in res.failures[0].witness)
 
+    def test_param_stored_to_field_by_callee(self, tmp_path):
+        """Field-sensitive param summaries: the callee stores its
+        PARAMETER to ``self._x`` — the caller's concrete taint must
+        land on the class-attr map and surface where the field is
+        read, two functions away from the source."""
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            class Box:
+                def store(self, v):
+                    self._x = v
+
+                def dump(self):
+                    logger.info(self._x)
+
+            BOX = Box()
+
+            def track(msg):
+                BOX.store(msg["request_key"])
+        """)})
+        assert _codes(res) == ["GL602"]
+        assert any("stored to Box._x" in s
+                   for s in res.failures[0].witness)
+
+    def test_param_stored_to_field_sanitized_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": _logged("""
+            import hashlib
+
+            class Box:
+                def store(self, v):
+                    self._x = v
+
+                def dump(self):
+                    logger.info(self._x)
+
+            BOX = Box()
+
+            def track(msg):
+                key = msg["request_key"]
+                BOX.store(hashlib.sha256(key.encode()).hexdigest())
+        """)})
+        assert _codes(res) == []
+
     def test_sanitizers_kill_the_flow(self, tmp_path):
         res = _lint(tmp_path, {"pkg/a.py": _logged("""
             import hashlib
@@ -334,6 +375,49 @@ class TestGL603:
                 return 2
         """})
         assert _codes(res) == ["GL603"]
+
+    def test_implicit_raise_through_callee_fires(self, tmp_path):
+        """The release is on the fall-through path, but a callee
+        BETWEEN acquire and release raises untyped and nothing covers
+        it at the call site — the exception propagates through this
+        frame and the pages leak."""
+        res = _lint(tmp_path, {"pkg/a.py": """
+            def reshard(table):
+                raise ValueError("row count drifted")
+
+            class Engine:
+                def grab(self, table):
+                    pages = self._pool.alloc(4)
+                    reshard(table)
+                    self._pool.release(pages)
+        """})
+        assert _codes(res) == ["GL603"]
+        assert "implicit exception path" in res.failures[0].message
+        assert "reshard()" in res.failures[0].message
+
+    def test_implicit_raise_covered_at_call_site_is_quiet(self, tmp_path):
+        res = _lint(tmp_path, {"pkg/a.py": """
+            def reshard(table):
+                raise ValueError("row count drifted")
+
+            class Engine:
+                def grab(self, table):
+                    pages = self._pool.alloc(4)
+                    try:
+                        reshard(table)
+                    except ValueError:
+                        pass
+                    self._pool.release(pages)
+
+                def grab_finally(self, table):
+                    pages = self._pool.alloc(4)
+                    try:
+                        reshard(table)
+                        self._pool.release(pages)
+                    except ValueError:
+                        self._pool.release(pages)
+        """})
+        assert _codes(res) == []
 
     def test_non_with_lock_acquire_must_release(self, tmp_path):
         res = _lint(tmp_path, {"pkg/a.py": """

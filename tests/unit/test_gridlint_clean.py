@@ -18,7 +18,12 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 
 
 def test_gridlint_suite_is_clean_and_fast():
+    from pygrid_tpu.analysis.checkers import ALL_CHECKERS
     from pygrid_tpu.analysis.graph import ProgramGraph
+
+    # the default suite must include the protocol family — a clean run
+    # that silently dropped GL7 would prove nothing about the wire
+    assert any(c.name == "GL7" for c in ALL_CHECKERS)
 
     builds_before = ProgramGraph.builds
     t0 = time.perf_counter()
